@@ -1,0 +1,15 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU platform.
+
+Must run before any ``jax`` import (SURVEY.md §4 "Distributed tests": fake a
+pod slice with ``xla_force_host_platform_device_count``, the moral
+equivalent of the reference's in-process network dict).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

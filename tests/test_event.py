@@ -1,0 +1,57 @@
+"""Event model: serialization round-trip, hashing, signatures."""
+
+from tpu_swirld import crypto
+from tpu_swirld.oracle.event import Event, decode_event, encode_event
+
+
+def make_event(payload=b"tx", parents=(), t=7):
+    pk, sk = crypto.keypair(b"seed-1")
+    return Event(d=payload, p=parents, t=t, c=pk).signed(sk), pk, sk
+
+
+def test_id_stable_and_signature_valid():
+    ev, pk, _sk = make_event()
+    assert len(ev.id) == crypto.HASH_BYTES
+    assert ev.id == ev.id
+    assert ev.verify()
+
+
+def test_id_changes_with_content():
+    ev1, _, _ = make_event(payload=b"a")
+    ev2, _, _ = make_event(payload=b"b")
+    assert ev1.id != ev2.id
+
+
+def test_tampered_signature_fails():
+    ev, _, _ = make_event()
+    bad = Event(d=ev.d, p=ev.p, t=ev.t, c=ev.c, s=bytes(len(ev.s)))
+    assert not bad.verify()
+
+
+def test_encode_decode_roundtrip():
+    g, pk, sk = make_event()
+    child = Event(d=b"x" * 100, p=(g.id, g.id), t=99, c=pk).signed(sk)
+    blob = encode_event(g) + encode_event(child)
+    e1, off = decode_event(blob, 0)
+    e2, off = decode_event(blob, off)
+    assert off == len(blob)
+    assert e1 == g
+    assert e2 == child
+    assert e2.id == child.id
+
+
+def test_coin_bit_in_range():
+    ev, _, _ = make_event()
+    assert ev.coin_bit() in (0, 1)
+
+
+def test_sim_crypto_backend_roundtrip():
+    crypto.set_backend("sim")
+    try:
+        pk, sk = crypto.keypair(b"s")
+        sig = crypto.sign(b"body", sk)
+        assert len(sig) == crypto.SIG_BYTES
+        assert crypto.verify(b"body", sig, pk)
+        assert not crypto.verify(b"other", sig, pk)
+    finally:
+        crypto.set_backend("ed25519")

@@ -1,0 +1,39 @@
+"""Graph utilities: bfs/dfs/toposort."""
+
+import pytest
+
+from tpu_swirld.oracle.graph import bfs, dfs, toposort
+
+#      a
+#     / \
+#    b   c
+#     \ / \
+#      d   e
+EDGES = {"a": [], "b": ["a"], "c": ["a"], "d": ["b", "c"], "e": ["c"]}
+CHILDREN = {"a": ["b", "c"], "b": ["d"], "c": ["d", "e"], "d": [], "e": []}
+
+
+def test_bfs_visits_all_once():
+    seen = list(bfs(["a"], lambda n: CHILDREN[n]))
+    assert sorted(seen) == ["a", "b", "c", "d", "e"]
+    assert len(set(seen)) == len(seen)
+    assert seen[0] == "a"
+
+
+def test_dfs_visits_all_once():
+    seen = list(dfs(["d"], lambda n: EDGES[n]))
+    assert sorted(seen) == ["a", "b", "c", "d"]
+
+
+def test_toposort_parents_first():
+    order = toposort(["e", "d", "c", "b", "a"], lambda n: EDGES[n])
+    pos = {n: i for i, n in enumerate(order)}
+    for node, parents in EDGES.items():
+        for p in parents:
+            assert pos[p] < pos[node]
+
+
+def test_toposort_cycle_raises():
+    cyc = {"x": ["y"], "y": ["x"]}
+    with pytest.raises(ValueError):
+        toposort(["x", "y"], lambda n: cyc[n])

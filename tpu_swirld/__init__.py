@@ -1,0 +1,31 @@
+"""tpu_swirld — a TPU-native hashgraph-consensus framework.
+
+A from-scratch reimplementation of the capabilities of the reference
+pure-Python hashgraph prototype (upstream layout: ``swirld.py`` /
+``utils.py`` / ``viz.py``; see SURVEY.md — the reference mount was empty,
+so SURVEY.md + BASELINE.json pin the spec), redesigned TPU-first:
+
+- ``tpu_swirld.oracle`` — the pure-Python reference ``Node`` (events,
+  validation, signed gossip sync, ``divide_rounds`` / ``decide_fame`` /
+  ``find_order``).  It is the bit-exactness oracle for the device path.
+- ``tpu_swirld.packing`` — dense append-only packer: hash-DAG -> index
+  arrays (``parents: int32[N,2]``, creator, seq, timestamps, coin bits).
+- ``tpu_swirld.tpu`` — the batched JAX/XLA consensus pipeline: blockwise
+  boolean-matmul ancestry, fork-aware ``see``, member-hop strongly-see
+  (MXU matmuls), witness/round scan, fame fixed point with coin rounds,
+  order extraction.  Bit-identical to the oracle by construction.
+- ``tpu_swirld.parallel`` — SPMD sharding of the pipeline over a
+  ``jax.sharding.Mesh`` (members and event-blocks axes) with psum /
+  all_gather collectives.
+- ``tpu_swirld.sim`` — in-process multi-node gossip simulation harness
+  (the reference's ``test(n_nodes, n_turns)``), plus a byzantine
+  fork-injecting adversary.
+"""
+
+from tpu_swirld.config import SwirldConfig
+from tpu_swirld.oracle.node import Node
+from tpu_swirld.oracle.event import Event
+
+__version__ = "0.3.0"
+
+__all__ = ["SwirldConfig", "Node", "Event", "__version__"]

@@ -1,0 +1,62 @@
+"""Configuration for the hashgraph framework.
+
+The reference keeps its constants inline in source (coin period ``C = 6``,
+stake passed to ``Node.__init__``, sim sizes as function args — SURVEY.md §5
+"Config / flag system: none").  Here they live in one dataclass shared by the
+oracle, the simulator, and the TPU pipeline so that both backends always agree
+on the protocol parameters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class SwirldConfig:
+    """Protocol + engine parameters.
+
+    Attributes:
+      n_members: number of members (nodes) in the population.
+      stake: per-member stake; ``None`` means one unit each.  Supermajority
+        is *strictly more than* 2/3 of total stake, evaluated in exact
+        integer arithmetic (``3 * x > 2 * tot``) on both backends.
+      coin_period: every ``coin_period``-th fame-voting round is a coin
+        round (the reference's ``C = 6``).
+      backend: ``"python"`` (oracle) or ``"tpu"`` (batched JAX pipeline) —
+        the pluggable seam named in BASELINE.json.
+      seed: base RNG seed for simulations.
+      mesh_shape: device mesh as ``{axis_name: size}`` for the sharded
+        pipeline; ``None`` → single device.
+      block_size: event-block tile for the blockwise ancestry kernel.
+      max_rounds: static bound on the number of created rounds for device
+        tables (checked against the actual data; raise if exceeded).
+    """
+
+    n_members: int = 4
+    stake: Optional[Tuple[int, ...]] = None
+    coin_period: int = 6
+    backend: str = "python"
+    seed: int = 0
+    mesh_shape: Optional[Dict[str, int]] = None
+    block_size: int = 256
+    max_rounds: int = 256
+
+    def stakes(self) -> Tuple[int, ...]:
+        if self.stake is not None:
+            if len(self.stake) != self.n_members:
+                raise ValueError(
+                    f"stake has {len(self.stake)} entries for "
+                    f"{self.n_members} members"
+                )
+            return tuple(int(s) for s in self.stake)
+        return tuple(1 for _ in range(self.n_members))
+
+    @property
+    def total_stake(self) -> int:
+        return sum(self.stakes())
+
+    def supermajority(self, amount: int) -> bool:
+        """True iff ``amount`` is strictly more than 2/3 of total stake."""
+        return 3 * amount > 2 * self.total_stake

@@ -1,0 +1,133 @@
+"""Crypto backend: event hashing, keypairs, detached signatures.
+
+The reference reaches libsodium through a Python binding for exactly three
+primitives: ed25519 keypair/sign/verify and a generic hash (SURVEY.md §2
+component 11 — "no C++ parity obligation beyond crypto").  This module
+provides the same three primitives behind a small interface:
+
+- Hashing is BLAKE2b-256 from ``hashlib`` (same algorithm family as
+  libsodium's ``crypto_generichash``).
+- Signatures use real Ed25519 via the ``cryptography`` package when it is
+  importable.  Otherwise a clearly-labelled *simulation* scheme is used:
+  ``sig = BLAKE2b(pub || body)``, publicly recomputable.  It preserves the
+  properties the protocol logic actually consumes — determinism, fixed
+  64-byte width, verifiability, and pseudo-random bits for coin rounds —
+  but offers **no** unforgeability; it exists so the framework runs in
+  hermetic environments with no crypto library.  The backend is pluggable
+  per-process via :func:`set_backend`.
+
+Coin-round bits are taken from the middle byte of the signature on both
+backends, mirroring the reference's "pseudo-random bit from the middle of
+the signature" (SURVEY.md §2 component 7).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Tuple
+
+HASH_BYTES = 32
+SIG_BYTES = 64
+
+
+def hash_bytes(data: bytes) -> bytes:
+    """BLAKE2b-256 generic hash (event IDs, whitening)."""
+    return hashlib.blake2b(data, digest_size=HASH_BYTES).digest()
+
+
+class SimSigner:
+    """Deterministic, verifiable, NON-SECURE simulation signatures."""
+
+    name = "sim"
+
+    def keypair(self, seed: bytes) -> Tuple[bytes, bytes]:
+        sk = hashlib.blake2b(b"sk" + seed, digest_size=32).digest()
+        pk = hashlib.blake2b(b"pk" + sk, digest_size=32).digest()
+        return pk, sk
+
+    def sign(self, body: bytes, sk: bytes) -> bytes:
+        pk = hashlib.blake2b(b"pk" + sk, digest_size=32).digest()
+        return hashlib.blake2b(pk + body, digest_size=SIG_BYTES).digest()
+
+    def verify(self, body: bytes, sig: bytes, pk: bytes) -> bool:
+        return sig == hashlib.blake2b(pk + body, digest_size=SIG_BYTES).digest()
+
+
+class Ed25519Signer:
+    """Real Ed25519 via the ``cryptography`` package (if importable)."""
+
+    name = "ed25519"
+
+    def __init__(self):
+        from cryptography.hazmat.primitives.asymmetric import ed25519 as _ed
+
+        self._ed = _ed
+        self._pub_cache = {}
+
+    def keypair(self, seed: bytes) -> Tuple[bytes, bytes]:
+        sk_seed = hashlib.blake2b(b"sk" + seed, digest_size=32).digest()
+        priv = self._ed.Ed25519PrivateKey.from_private_bytes(sk_seed)
+        from cryptography.hazmat.primitives import serialization as ser
+
+        pk = priv.public_key().public_bytes(
+            ser.Encoding.Raw, ser.PublicFormat.Raw
+        )
+        return pk, sk_seed
+
+    def sign(self, body: bytes, sk: bytes) -> bytes:
+        priv = self._ed.Ed25519PrivateKey.from_private_bytes(sk)
+        return priv.sign(body)
+
+    def verify(self, body: bytes, sig: bytes, pk: bytes) -> bool:
+        key = self._pub_cache.get(pk)
+        if key is None:
+            key = self._ed.Ed25519PublicKey.from_public_bytes(pk)
+            self._pub_cache[pk] = key
+        try:
+            key.verify(sig, body)
+            return True
+        except Exception:
+            return False
+
+
+def _default_backend():
+    try:
+        return Ed25519Signer()
+    except Exception:
+        return SimSigner()
+
+
+_BACKEND = _default_backend()
+
+
+def set_backend(name: str) -> None:
+    """Select the signature backend: ``"ed25519"`` or ``"sim"``."""
+    global _BACKEND
+    if name == "ed25519":
+        _BACKEND = Ed25519Signer()
+    elif name == "sim":
+        _BACKEND = SimSigner()
+    else:
+        raise ValueError(f"unknown crypto backend {name!r}")
+
+
+def backend_name() -> str:
+    return _BACKEND.name
+
+
+def keypair(seed: bytes) -> Tuple[bytes, bytes]:
+    """Deterministic (pub, priv) keypair from a seed."""
+    return _BACKEND.keypair(seed)
+
+
+def sign(body: bytes, sk: bytes) -> bytes:
+    return _BACKEND.sign(body, sk)
+
+
+def verify(body: bytes, sig: bytes, pk: bytes) -> bool:
+    return _BACKEND.verify(body, sig, pk)
+
+
+def coin_bit(sig: bytes) -> int:
+    """Pseudo-random coin-round bit: low bit of the signature's middle byte."""
+    return sig[len(sig) // 2] & 1

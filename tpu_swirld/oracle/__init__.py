@@ -1,0 +1,6 @@
+"""Pure-Python reference oracle (the ground-truth consensus backend)."""
+
+from tpu_swirld.oracle.event import Event
+from tpu_swirld.oracle.node import Node
+
+__all__ = ["Event", "Node"]

@@ -1,0 +1,100 @@
+"""The immutable hashgraph event record.
+
+Mirrors the reference's five-field event (upstream ``swirld.py`` top:
+``Event = namedtuple('Event', 'd p t c s')`` — SURVEY.md §2 component 1):
+``d`` payload, ``p`` parent-hash pair (self-parent, other-parent; ``()``
+for genesis), ``t`` creation timestamp, ``c`` creator public key, ``s``
+detached signature over the serialized body.  The BLAKE2b hash of the
+serialized body is the event's identity.
+
+Serialization is a fixed, explicit byte layout (not pickle) so that event
+IDs are stable across Python versions and host/device boundaries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import Optional, Tuple
+
+from tpu_swirld import crypto
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    d: bytes                       # payload (opaque transaction bytes)
+    p: Tuple[bytes, ...]           # () for genesis, else (self_parent, other_parent)
+    t: int                         # creation timestamp (integer; never float)
+    c: bytes                       # creator public key
+    s: bytes = b""                 # detached signature over body()
+
+    def body(self) -> bytes:
+        """Deterministic serialization of everything except the signature."""
+        parts = [struct.pack("<B", len(self.p))]
+        for ph in self.p:
+            parts.append(ph)
+        parts.append(struct.pack("<q", self.t))
+        parts.append(struct.pack("<I", len(self.c)))
+        parts.append(self.c)
+        parts.append(struct.pack("<I", len(self.d)))
+        parts.append(self.d)
+        return b"".join(parts)
+
+    @property
+    def id(self) -> bytes:
+        return crypto.hash_bytes(self.body())
+
+    @property
+    def self_parent(self) -> Optional[bytes]:
+        return self.p[0] if self.p else None
+
+    @property
+    def other_parent(self) -> Optional[bytes]:
+        return self.p[1] if self.p else None
+
+    def signed(self, sk: bytes) -> "Event":
+        return dataclasses.replace(self, s=crypto.sign(self.body(), sk))
+
+    def verify(self) -> bool:
+        return crypto.verify(self.body(), self.s, self.c)
+
+    def coin_bit(self) -> int:
+        return crypto.coin_bit(self.s)
+
+
+def encode_event(ev: Event) -> bytes:
+    """Wire encoding: body || sig (lengths are implicit in the body layout)."""
+    body = ev.body()
+    return struct.pack("<I", len(body)) + body + struct.pack("<I", len(ev.s)) + ev.s
+
+
+def decode_event(data: bytes, offset: int = 0) -> Tuple[Event, int]:
+    """Inverse of :func:`encode_event`; returns (event, next_offset)."""
+    (blen,) = struct.unpack_from("<I", data, offset)
+    offset += 4
+    body = data[offset : offset + blen]
+    offset += blen
+    (slen,) = struct.unpack_from("<I", data, offset)
+    offset += 4
+    sig = data[offset : offset + slen]
+    offset += slen
+
+    # Parse the body layout written by Event.body().
+    pos = 0
+    (np_,) = struct.unpack_from("<B", body, pos)
+    pos += 1
+    parents = []
+    for _ in range(np_):
+        parents.append(body[pos : pos + crypto.HASH_BYTES])
+        pos += crypto.HASH_BYTES
+    (t,) = struct.unpack_from("<q", body, pos)
+    pos += 8
+    (clen,) = struct.unpack_from("<I", body, pos)
+    pos += 4
+    c = body[pos : pos + clen]
+    pos += clen
+    (dlen,) = struct.unpack_from("<I", body, pos)
+    pos += 4
+    d = body[pos : pos + dlen]
+    pos += dlen
+    return Event(d=d, p=tuple(parents), t=t, c=c, s=sig), offset

@@ -1,0 +1,549 @@
+"""The pure-Python reference ``Node`` — the consensus oracle.
+
+This is a from-scratch implementation of Swirlds hashgraph consensus
+(Baird, SWIRLDS-TR-2016-01) with the same public surface as the reference
+prototype (upstream ``swirld.py``: ``Node.sync`` / ``ask_sync`` /
+``divide_rounds`` / ``decide_fame`` / ``find_order`` — SURVEY.md §2,
+BASELINE.json API pin).  It serves two roles:
+
+1. The ``backend='python'`` consensus engine.
+2. The bit-exactness oracle the TPU pipeline is property-tested against
+   (`round` / `witness` / `famous` / consensus order must match exactly).
+
+Precise rule choices (shared with :mod:`tpu_swirld.tpu.pipeline`; where the
+unreadable reference left details ambiguous, these are OUR spec, documented
+here so both backends agree):
+
+- *ancestor*: reflexive-transitive parent closure (an event is its own
+  ancestor).
+- *fork*: two events by the same creator, neither an ancestor of the other.
+  Minimal fork pairs always share (creator, seq); detection keys on that.
+- *see*: ``x sees y`` iff ``y`` is an ancestor of ``x`` and ``x`` does NOT
+  have a fork pair by ``y``'s creator among its ancestors.
+- *strongly see*: ``x`` strongly sees ``y`` iff members holding a strict
+  2/3-supermajority of stake each have an event ``z`` with ``x sees z`` and
+  ``z sees y``.  All supermajorities are exact integer tests
+  ``3*amount > 2*total``.
+- *round*: ``r = max(parent rounds)``; promoted to ``r+1`` iff the event
+  strongly sees round-``r`` witnesses whose creators hold a supermajority
+  of stake (distinct creators counted once).  Genesis events are round 0.
+- *witness*: first event of a creator in its round (genesis, or
+  ``round > round(self_parent)``).
+- *fame votes*: a round-``ry`` witness ``y`` votes on a round-``rx``
+  witness ``x`` (``d = ry - rx``): at ``d == 1`` the vote is ``y sees x``;
+  at ``d > 1`` tally over distinct creators of the round-``(ry-1)``
+  witnesses ``y`` strongly sees — a creator contributes its stake to "yes"
+  if any of its strongly-seen witnesses voted yes, and to "no" likewise.
+  Majority value is ``yes >= no``.  In a non-coin round (``d % C != 0``) a
+  supermajority tally decides fame; in a coin round a supermajority sets
+  the vote, otherwise the vote is the middle bit of ``y``'s signature.
+  Fame is the value of the chronologically first deciding round.
+- *round received* of event ``x``: the first fame-complete round ``r``
+  whose unique famous witnesses (famous witnesses whose creator has
+  exactly one famous witness in ``r``) ALL have ``x`` as ancestor.  Rounds
+  with zero unique famous witnesses receive nothing.
+- *consensus timestamp*: lower-median (index ``(n-1)//2`` of the sorted
+  list) of, per unique famous witness ``w``, the timestamp of the earliest
+  self-ancestor of ``w`` that has ``x`` as an ancestor.
+- *final order*: sort by (round received, consensus timestamp,
+  ``BLAKE2b(whiten || id)``) where ``whiten`` is the XOR of the unique
+  famous witnesses' signatures.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from tpu_swirld import crypto
+from tpu_swirld.config import SwirldConfig
+from tpu_swirld.oracle.event import Event, decode_event, encode_event
+from tpu_swirld.oracle.graph import toposort
+
+
+def _bit_count(x: int) -> int:
+    return x.bit_count()
+
+
+def xor_bytes(a: bytes, b: bytes) -> bytes:
+    return bytes(x ^ y for x, y in zip(a, b))
+
+
+class Node:
+    """One hashgraph member: event store, gossip endpoint, consensus state."""
+
+    def __init__(
+        self,
+        sk: bytes,
+        pk: bytes,
+        network: Dict[bytes, Callable],
+        members: Sequence[bytes],
+        config: Optional[SwirldConfig] = None,
+        clock: Optional[Callable[[], int]] = None,
+    ):
+        self.config = config or SwirldConfig(n_members=len(members))
+        if len(members) != self.config.n_members:
+            raise ValueError("members length != config.n_members")
+        self.sk = sk
+        self.pk = pk
+        self.network = network
+        self.members: List[bytes] = list(members)
+        self.member_index: Dict[bytes, int] = {m: i for i, m in enumerate(members)}
+        stakes = self.config.stakes()
+        self.stake: Dict[bytes, int] = {m: stakes[i] for i, m in enumerate(members)}
+        self.tot_stake = sum(stakes)
+        self._clock = clock or self._lamport_clock
+
+        # --- event store / DAG ---
+        self.hg: Dict[bytes, Event] = {}          # id -> Event
+        self.idx: Dict[bytes, int] = {}           # id -> topo insertion index
+        self.order_added: List[bytes] = []        # insertion (topo) order
+        self.anc: Dict[bytes, int] = {}           # id -> ancestor bitmask (incl. self)
+        self.seq: Dict[bytes, int] = {}           # id -> self-chain height
+        self.member_mask: Dict[bytes, int] = {m: 0 for m in members}
+        self.member_events: Dict[bytes, List[bytes]] = {m: [] for m in members}
+        self.member_chain: Dict[bytes, List[bytes]] = {m: [] for m in members}
+        self.by_seq: Dict[bytes, Dict[int, List[bytes]]] = {m: {} for m in members}
+        self.fork_groups: Dict[bytes, Dict[int, List[bytes]]] = {m: {} for m in members}
+        self.has_fork: Dict[bytes, bool] = {m: False for m in members}
+        self._forkseen_memo: Dict[Tuple[bytes, bytes], bool] = {}
+        self.head: Optional[bytes] = None
+
+        # --- consensus state ---
+        self.round: Dict[bytes, int] = {}
+        self.is_witness: Dict[bytes, bool] = {}
+        self.witnesses: Dict[int, Dict[bytes, List[bytes]]] = {}  # r -> creator -> ids
+        self.wit_list: Dict[int, List[bytes]] = {}                # r -> slot-ordered ids
+        self.wit_slot: Dict[bytes, int] = {}                      # witness id -> slot
+        self.compact: Dict[bytes, Dict[int, int]] = {}            # id -> {r: slot bitmask}
+        self._tips_memo: Dict[bytes, Dict[bytes, List[bytes]]] = {}
+        self.max_round = 0
+        self.famous: Dict[bytes, Optional[bool]] = {}
+        self.votes: Dict[Tuple[bytes, bytes], bool] = {}
+        self._next_vote_round: Dict[bytes, int] = {}   # witness id -> next ry to process
+        self._frozen_round = -1                        # rounds <= this are fame-complete
+
+        # --- ordering state ---
+        self.tbd: List[bytes] = []                 # insertion-ordered, not yet received
+        self.round_received: Dict[bytes, int] = {}
+        self.consensus_ts: Dict[bytes, int] = {}
+        self.consensus: List[bytes] = []           # final total order (event ids)
+        self.transactions: List[bytes] = []        # payloads in consensus order
+        self.consensus_round = 0                   # next round to try ordering with
+
+        # genesis event for self
+        genesis = Event(d=b"", p=(), t=self._now(), c=pk).signed(sk)
+        self.add_event(genesis)
+        self.divide_rounds([genesis.id])
+
+    # ------------------------------------------------------------------ utils
+
+    def _lamport_clock(self) -> int:
+        return len(self.order_added)
+
+    def _now(self) -> int:
+        t = int(self._clock())
+        if self.head is not None:
+            t = max(t, self.hg[self.head].t + 1)
+        return t
+
+    # ------------------------------------------------- event creation / store
+
+    def new_event(self, payload: bytes, other_parent: Optional[bytes]) -> Event:
+        """Create and sign a new head event (genesis if no head yet)."""
+        if self.head is None:
+            parents: Tuple[bytes, ...] = ()
+        else:
+            if other_parent is None:
+                raise ValueError("non-genesis event needs an other-parent")
+            parents = (self.head, other_parent)
+        return Event(d=payload, p=parents, t=self._now(), c=self.pk).signed(self.sk)
+
+    def is_valid_event(self, ev: Event) -> bool:
+        """Structural + cryptographic validation (reference: hash/signature/
+        parent checks incl. fork-relevant creator constraints)."""
+        if ev.c not in self.member_index:
+            return False
+        if not ev.verify():
+            return False
+        if len(ev.p) not in (0, 2):
+            return False
+        if ev.p:
+            sp, op = ev.p
+            if sp not in self.hg or op not in self.hg:
+                return False
+            if self.hg[sp].c != ev.c:          # self-parent must share creator
+                return False
+            if self.hg[op].c == ev.c:          # other-parent must not
+                return False
+        return True
+
+    def add_event(self, ev: Event) -> bool:
+        """Insert a validated event; idempotent.  Returns True if new."""
+        eid = ev.id
+        if eid in self.hg:
+            return False
+        if not self.is_valid_event(ev):
+            raise ValueError("invalid event")
+        i = len(self.order_added)
+        self.hg[eid] = ev
+        self.idx[eid] = i
+        self.order_added.append(eid)
+        bit = 1 << i
+        if ev.p:
+            sp, op = ev.p
+            self.anc[eid] = bit | self.anc[sp] | self.anc[op]
+            self.seq[eid] = self.seq[sp] + 1
+        else:
+            self.anc[eid] = bit
+            self.seq[eid] = 0
+        c = ev.c
+        s = self.seq[eid]
+        self.member_mask[c] |= bit
+        self.member_events[c].append(eid)
+        group = self.by_seq[c].setdefault(s, [])
+        group.append(eid)
+        if len(group) == 2:
+            # first fork at this (creator, seq)
+            self.fork_groups[c][s] = group
+            self.has_fork[c] = True
+        if not self.has_fork[c]:
+            self.member_chain[c].append(eid)   # index == seq while honest
+        if c == self.pk:
+            self.head = eid
+        self.tbd.append(eid)
+        return True
+
+    # ------------------------------------------------------------ visibility
+
+    def in_anc(self, container: bytes, member_of: bytes) -> bool:
+        """Is event ``member_of`` an ancestor of ``container``?"""
+        return (self.anc[container] >> self.idx[member_of]) & 1 == 1
+
+    def forkseen(self, eid: bytes, m: bytes) -> bool:
+        """Does ``eid`` have a fork pair by member ``m`` among its ancestors?"""
+        if not self.has_fork[m]:
+            return False
+        key = (eid, m)
+        memo = self._forkseen_memo.get(key)
+        if memo is not None:
+            return memo
+        a = self.anc[eid]
+        result = False
+        for _s, ids in self.fork_groups[m].items():
+            hits = 0
+            for fid in ids:
+                if (a >> self.idx[fid]) & 1:
+                    hits += 1
+                    if hits >= 2:
+                        result = True
+                        break
+            if result:
+                break
+        self._forkseen_memo[key] = result
+        return result
+
+    def sees(self, x: bytes, y: bytes) -> bool:
+        """Fork-aware visibility: y ancestor of x, no fork by y's creator."""
+        return self.in_anc(x, y) and not self.forkseen(x, self.hg[y].c)
+
+    def _tips(self, eid: bytes) -> Dict[bytes, List[bytes]]:
+        """Per member, the maximal events of that member among eid's ancestors."""
+        memo = self._tips_memo.get(eid)
+        if memo is not None:
+            return memo
+        a = self.anc[eid]
+        tips: Dict[bytes, List[bytes]] = {}
+        for m in self.members:
+            if not self.has_fork[m]:
+                cnt = _bit_count(a & self.member_mask[m])
+                if cnt:
+                    tips[m] = [self.member_chain[m][cnt - 1]]
+            else:
+                found: List[bytes] = []
+                for cand in reversed(self.member_events[m]):
+                    if not (a >> self.idx[cand]) & 1:
+                        continue
+                    if any(self.in_anc(f, cand) for f in found):
+                        continue
+                    found.append(cand)
+                if found:
+                    tips[m] = found
+        self._tips_memo[eid] = tips
+        return tips
+
+    def strongly_sees(self, x: bytes, w: bytes) -> bool:
+        """x strongly sees w: supermajority of member stake has an event z
+        with (x sees z) and (z sees w)."""
+        r = self.round[w]
+        slot_bit = 1 << self.wit_slot[w]
+        cw = self.hg[w].c
+        amount = 0
+        tips = self._tips(x)
+        for m, tlist in tips.items():
+            if self.forkseen(x, m):
+                continue  # x cannot see any event by a forked-visible member
+            for z in tlist:
+                if self.compact[z].get(r, 0) & slot_bit and not self.forkseen(z, cw):
+                    amount += self.stake[m]
+                    break
+        return 3 * amount > 2 * self.tot_stake
+
+    # ---------------------------------------------------------------- gossip
+
+    def heights(self) -> Dict[bytes, int]:
+        """Per-member count of known events (the sync height vector)."""
+        return {m: len(self.member_events[m]) for m in self.members}
+
+    def ask_sync(self, from_pk: bytes, signed_heights: bytes) -> bytes:
+        """Serve a sync: reply with the topo-sorted events the asker lacks.
+
+        The asker's height vector is signed; the reply (concatenated encoded
+        events) is signed by us.  (Reference contract: SURVEY.md §2 #4.)
+        """
+        payload = signed_heights[: -crypto.SIG_BYTES]
+        sig = signed_heights[-crypto.SIG_BYTES:]
+        if not crypto.verify(payload, sig, from_pk):
+            raise ValueError("bad sync-request signature")
+        heights: Dict[bytes, int] = {}
+        off = 0
+        for m in self.members:
+            heights[m] = int.from_bytes(payload[off : off + 4], "little")
+            off += 4
+        missing: List[bytes] = []
+        for m in self.members:
+            missing.extend(self.member_events[m][heights[m]:])
+        missing = toposort(
+            sorted(missing, key=lambda e: self.idx[e]),
+            lambda e: [p for p in self.hg[e].p],
+        )
+        blob = b"".join(encode_event(self.hg[e]) for e in missing)
+        return blob + crypto.sign(blob, self.sk)
+
+    def sync(self, peer_pk: bytes, payload: bytes) -> List[bytes]:
+        """Gossip with ``peer_pk``; returns new event ids in topo order
+        (received sub-DAG first, then our freshly created event)."""
+        hv = b"".join(
+            len(self.member_events[m]).to_bytes(4, "little") for m in self.members
+        )
+        req = hv + crypto.sign(hv, self.sk)
+        reply = self.network[peer_pk](self.pk, req)
+        blob = reply[: -crypto.SIG_BYTES]
+        sig = reply[-crypto.SIG_BYTES:]
+        if not crypto.verify(blob, sig, peer_pk):
+            raise ValueError("bad sync-reply signature")
+        new_ids: List[bytes] = []
+        off = 0
+        while off < len(blob):
+            ev, off = decode_event(blob, off)
+            if self.add_event(ev):
+                new_ids.append(ev.id)
+        peer_events = self.member_events[peer_pk]
+        if not peer_events:
+            return new_ids
+        peer_head = peer_events[-1]
+        mine = self.new_event(payload, peer_head)
+        self.add_event(mine)
+        new_ids.append(mine.id)
+        return new_ids
+
+    # ------------------------------------------------------------- consensus
+
+    def _register_witness(self, eid: bytes, r: int) -> None:
+        self.is_witness[eid] = True
+        slots = self.wit_list.setdefault(r, [])
+        self.wit_slot[eid] = len(slots)
+        slots.append(eid)
+        self.witnesses.setdefault(r, {}).setdefault(self.hg[eid].c, []).append(eid)
+        self.famous[eid] = None
+        self._next_vote_round[eid] = r + 1
+        self.compact[eid][r] = self.compact[eid].get(r, 0) | (1 << self.wit_slot[eid])
+        if r <= self._frozen_round:
+            raise AssertionError(
+                f"witness appeared in already-frozen round {r}; "
+                "straggler beyond the freeze horizon breaks batch parity"
+            )
+
+    def divide_rounds(self, new_ids: Iterable[bytes]) -> None:
+        """Assign round numbers and witness flags to ``new_ids`` (topo order).
+
+        Reference: ``Node.divide_rounds`` (SURVEY.md §2 #6) — hot loop 1.
+        """
+        for eid in new_ids:
+            ev = self.hg[eid]
+            if not ev.p:
+                self.round[eid] = 0
+                self.compact[eid] = {}
+                self._register_witness(eid, 0)
+                continue
+            sp, op = ev.p
+            r = max(self.round[sp], self.round[op])
+            # merge ancestor-witness slot masks from parents
+            comp: Dict[int, int] = dict(self.compact[sp])
+            for rr, mask in self.compact[op].items():
+                comp[rr] = comp.get(rr, 0) | mask
+            self.compact[eid] = comp
+            # promotion: strongly-seen round-r witnesses, distinct creators
+            amount = 0
+            for c, wids in self.witnesses.get(r, {}).items():
+                if any(self.strongly_sees(eid, w) for w in wids):
+                    amount += self.stake[c]
+            if 3 * amount > 2 * self.tot_stake:
+                r += 1
+            self.round[eid] = r
+            self.max_round = max(self.max_round, r)
+            if self.round[sp] < r:
+                self._register_witness(eid, r)
+            else:
+                self.is_witness[eid] = False
+
+    def _vote_tally(self, y: bytes, x: bytes, ry: int) -> Tuple[int, int]:
+        """Stake tallies (yes, no) over distinct creators of the round-(ry-1)
+        witnesses y strongly sees, using their (lazily computed) votes on x."""
+        yes = no = 0
+        for c, wids in self.witnesses.get(ry - 1, {}).items():
+            c_yes = c_no = False
+            for w in wids:
+                if self.strongly_sees(y, w):
+                    if self._vote(w, x):
+                        c_yes = True
+                    else:
+                        c_no = True
+            if c_yes:
+                yes += self.stake[c]
+            if c_no:
+                no += self.stake[c]
+        return yes, no
+
+    def _vote(self, y: bytes, x: bytes) -> bool:
+        """The vote of witness y on witness x — a memoized pure function of
+        the DAG (strongly-seen witnesses are ancestors of y, so every vote a
+        tally references exists whenever y exists; arrival order cannot
+        change any value)."""
+        key = (y, x)
+        memo = self.votes.get(key)
+        if memo is not None:
+            return memo
+        d = self.round[y] - self.round[x]
+        if d <= 1:
+            v = self.sees(y, x)
+        else:
+            yes, no = self._vote_tally(y, x, self.round[y])
+            v = yes >= no
+            if d % self.config.coin_period == 0 and not (
+                3 * max(yes, no) > 2 * self.tot_stake
+            ):
+                v = bool(self.hg[y].coin_bit())  # coin flip from signature
+        self.votes[key] = v
+        return v
+
+    def decide_fame(self) -> None:
+        """Virtual fame voting (reference ``Node.decide_fame``, hot loop 2).
+
+        Fame of x is the majority value at the chronologically first
+        non-coin round where some witness's tally reaches a stake
+        supermajority.  Vote values are pure functions of the DAG
+        (see :meth:`_vote`), so incremental processing converges to the
+        same fame assignment as a batch pass over the final DAG.
+        """
+        C = self.config.coin_period
+        for rx in sorted(self.wit_list):
+            for x in self.wit_list[rx]:
+                if self.famous[x] is not None:
+                    continue
+                for ry in range(max(self._next_vote_round[x], rx + 2), self.max_round + 1):
+                    d = ry - rx
+                    decided = False
+                    if d % C != 0:
+                        for y in self.wit_list.get(ry, []):
+                            yes, no = self._vote_tally(y, x, ry)
+                            if 3 * max(yes, no) > 2 * self.tot_stake:
+                                self.famous[x] = yes >= no
+                                decided = True
+                                break
+                    self._next_vote_round[x] = ry + 1
+                    if decided:
+                        break
+
+    def _fame_complete(self, r: int) -> bool:
+        if self.max_round < r + 2:
+            return False
+        return all(self.famous[w] is not None for w in self.wit_list.get(r, []))
+
+    def _self_chain(self, w: bytes) -> List[bytes]:
+        """w's self-ancestor chain, genesis first (explicit pointer walk so
+        forked creators are handled)."""
+        chain = []
+        cur: Optional[bytes] = w
+        while cur is not None:
+            chain.append(cur)
+            cur = self.hg[cur].self_parent
+        chain.reverse()
+        return chain
+
+    def _earliest_seeing_ts(self, w: bytes, x: bytes) -> int:
+        """Timestamp of the earliest self-ancestor of w that has x as an
+        ancestor (binary search: ancestry is monotone along the self-chain)."""
+        chain = self._self_chain(w)
+        lo, hi = 0, len(chain) - 1
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if self.in_anc(chain[mid], x):
+                hi = mid
+            else:
+                lo = mid + 1
+        return self.hg[chain[lo]].t
+
+    def find_order(self) -> None:
+        """Extract the consensus order (reference ``Node.find_order``, hot
+        loop 3).  Processes fame-complete rounds in ascending order."""
+        while self._fame_complete(self.consensus_round):
+            r = self.consensus_round
+            # unique famous witnesses: creators with exactly one famous witness
+            ufw: List[bytes] = []
+            for c, wids in self.witnesses.get(r, {}).items():
+                fam = [w for w in wids if self.famous[w]]
+                if len(fam) == 1:
+                    ufw.append(fam[0])
+            ufw.sort(key=lambda w: self.idx[w])
+            self._frozen_round = r
+            self.consensus_round += 1
+            if not ufw:
+                continue
+            whiten = bytes(crypto.SIG_BYTES)
+            for w in ufw:
+                whiten = xor_bytes(whiten, self.hg[w].s)
+            received: List[Tuple[int, bytes, bytes]] = []
+            remaining: List[bytes] = []
+            for x in self.tbd:
+                if all(self.in_anc(w, x) for w in ufw):
+                    ts = sorted(self._earliest_seeing_ts(w, x) for w in ufw)
+                    med = ts[(len(ts) - 1) // 2]
+                    self.round_received[x] = r
+                    self.consensus_ts[x] = med
+                    tie = crypto.hash_bytes(whiten + x)
+                    received.append((med, tie, x))
+                else:
+                    remaining.append(x)
+            self.tbd = remaining
+            received.sort(key=lambda item: (item[0], item[1]))
+            for med, _tie, x in received:
+                self.consensus.append(x)
+                self.transactions.append(self.hg[x].d)
+
+    # ------------------------------------------------------------- main loop
+
+    def consensus_pass(self, new_ids: List[bytes]) -> None:
+        """The three consensus calls in reference order (the pluggable seam)."""
+        self.divide_rounds(new_ids)
+        self.decide_fame()
+        self.find_order()
+
+    def main(self, pick_peer: Callable[[], bytes], payload_fn=None):
+        """Coroutine: each resume gossips with one random peer and runs a
+        consensus pass (reference ``Node.main``)."""
+        while True:
+            payload = payload_fn() if payload_fn else b""
+            peer = pick_peer()
+            new_ids = self.sync(peer, payload)
+            self.consensus_pass(new_ids)
+            yield new_ids
