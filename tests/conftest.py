@@ -7,9 +7,17 @@ equivalent of the reference's in-process network dict).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The machine's sitecustomize registers an 'axon' TPU-tunnel PJRT plugin and
+# forces jax_platforms="axon,cpu", overriding the env var; initializing the
+# axon backend can hang for minutes.  Forcing the config AFTER import (but
+# before any backend init) makes the CPU selection stick.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -73,3 +73,77 @@ def test_sim_with_forkers_stays_consistent():
     assert any(
         any(n.has_fork[mpk] for mpk in sim.members) for n in sim.nodes
     )
+
+
+def _manual_population(n=4, seed=77):
+    """Keys + one observer Node (last member) that we feed hand-built events."""
+    from tpu_swirld import crypto
+    from tpu_swirld.oracle.node import Node
+
+    keys = [crypto.keypair(b"ez-%d-%d" % (seed, i)) for i in range(n)]
+    members = [pk for pk, _ in keys]
+    node = Node(
+        sk=keys[-1][1], pk=members[-1], network={}, members=members,
+        clock=lambda: 0,
+    )
+    return keys, members, node
+
+
+def test_strongly_sees_exists_z_rule_on_fork_dag():
+    """Pins the normative ∃-z strongly-see rule on a hand-built fork DAG.
+
+    Member B's *tip* (b3) has fork-seen A (both branches of A's fork are
+    among its ancestors), so a tip-only rule would not let B act as an
+    intermediary towards A's witness w = gA.  But b1, an earlier event on
+    B's self-chain, sees w cleanly — the ∃-z rule counts member B.
+    """
+    keys, members, node = _manual_population()
+    (pkA, skA), (pkB, skB), (pkC, skC), (pkD, skD) = keys
+    t = [100]
+
+    def mk(creator_i, parents, payload=b""):
+        pk, sk = keys[creator_i]
+        t[0] += 1
+        ev = Event(d=payload, p=parents, t=t[0], c=pk).signed(sk)
+        node.add_event(ev)
+        return ev.id
+
+    gA = mk(0, ())
+    gB = mk(1, ())
+    gC = mk(2, ())
+    a1 = mk(0, (gA, gB))          # branch 1 of A's fork
+    a2 = mk(0, (gA, gC))          # branch 2 (same self-parent gA)
+    b1 = mk(1, (gB, gA))          # sees gA cleanly
+    b2 = mk(1, (b1, a1))          # sees one branch only
+    b3 = mk(1, (b2, a2))          # now fork-sees A
+    x1 = mk(3, (node.head, b3))   # D's event on top of everything
+
+    assert node.has_fork[pkA]
+    assert node.forkseen(b3, pkA) and not node.forkseen(b1, pkA)
+    # tip-only would reject B as intermediary (its tip is poisoned) ...
+    assert not node.sees(b3, gA)
+    # ... but the ∃-z rule accepts it through b1:
+    assert node._sees_through(x1, gA, pkB)
+    # A itself is fork-seen by x1, so no event by A can be the z:
+    assert node.forkseen(x1, pkA)
+    assert not node._sees_through(x1, gA, pkA)
+    # C's only ancestor-event of x1 is its genesis, which does not see gA:
+    assert not node._sees_through(x1, gA, pkC)
+    # D's earliest chain event seeing gA is x1 itself, which fork-sees A:
+    assert not node._sees_through(x1, gA, pkD)
+    # hence only B (1 of 4 stake) qualifies -> no strong seeing:
+    assert not node.strongly_sees(x1, gA)
+
+
+def test_straggler_witness_quarantined_not_crash():
+    """A witness landing in a fame-complete (frozen) round must be
+    quarantined, not kill the node (VERDICT r4 weak #2)."""
+    keys, members, node = _manual_population()
+    node._frozen_round = 0  # pretend round 0 fame is complete
+    pkA, skA = keys[0]
+    ev = Event(d=b"", p=(), t=5, c=pkA).signed(skA)
+    node.add_event(ev)
+    node.divide_rounds([ev.id])   # genesis witness in frozen round 0
+    assert ev.id in node.ancient
+    assert node.is_witness[ev.id]
+    assert ev.id not in node.wit_slot

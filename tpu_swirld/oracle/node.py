@@ -21,8 +21,11 @@ here so both backends agree):
 - *see*: ``x sees y`` iff ``y`` is an ancestor of ``x`` and ``x`` does NOT
   have a fork pair by ``y``'s creator among its ancestors.
 - *strongly see*: ``x`` strongly sees ``y`` iff members holding a strict
-  2/3-supermajority of stake each have an event ``z`` with ``x sees z`` and
-  ``z sees y``.  All supermajorities are exact integer tests
+  2/3-supermajority of stake each have an event ``z`` (ANY event by that
+  member, not just a maximal tip) with ``x sees z`` and ``z sees y``.
+  This is the normative ∃-z rule, implemented exactly on both backends
+  (see :meth:`Node.strongly_sees`; pinned by a hand-built fork DAG test in
+  ``tests/test_fork.py``).  All supermajorities are exact integer tests
   ``3*amount > 2*total``.
 - *round*: ``r = max(parent rounds)``; promoted to ``r+1`` iff the event
   strongly sees round-``r`` witnesses whose creators hold a supermajority
@@ -114,8 +117,8 @@ class Node:
         self.witnesses: Dict[int, Dict[bytes, List[bytes]]] = {}  # r -> creator -> ids
         self.wit_list: Dict[int, List[bytes]] = {}                # r -> slot-ordered ids
         self.wit_slot: Dict[bytes, int] = {}                      # witness id -> slot
-        self.compact: Dict[bytes, Dict[int, int]] = {}            # id -> {r: slot bitmask}
-        self._tips_memo: Dict[bytes, Dict[bytes, List[bytes]]] = {}
+        self._ss_memo: Dict[Tuple[bytes, bytes], bool] = {}
+        self.ancient: List[bytes] = []   # quarantined straggler witnesses
         self.max_round = 0
         self.famous: Dict[bytes, Optional[bool]] = {}
         self.votes: Dict[Tuple[bytes, bytes], bool] = {}
@@ -246,47 +249,61 @@ class Node:
         """Fork-aware visibility: y ancestor of x, no fork by y's creator."""
         return self.in_anc(x, y) and not self.forkseen(x, self.hg[y].c)
 
-    def _tips(self, eid: bytes) -> Dict[bytes, List[bytes]]:
-        """Per member, the maximal events of that member among eid's ancestors."""
-        memo = self._tips_memo.get(eid)
-        if memo is not None:
-            return memo
-        a = self.anc[eid]
-        tips: Dict[bytes, List[bytes]] = {}
-        for m in self.members:
-            if not self.has_fork[m]:
-                cnt = _bit_count(a & self.member_mask[m])
-                if cnt:
-                    tips[m] = [self.member_chain[m][cnt - 1]]
-            else:
-                found: List[bytes] = []
-                for cand in reversed(self.member_events[m]):
-                    if not (a >> self.idx[cand]) & 1:
-                        continue
-                    if any(self.in_anc(f, cand) for f in found):
-                        continue
-                    found.append(cand)
-                if found:
-                    tips[m] = found
-        self._tips_memo[eid] = tips
-        return tips
+    def _sees_through(self, x: bytes, w: bytes, m: bytes) -> bool:
+        """∃ event z by member m with (x sees z) and (z sees w).
+
+        For an honest (fork-free) m, z ranges over the prefix of m's
+        self-chain that is in x's ancestry; ``anc(z, w)`` and
+        ``forkseen(z, c(w))`` are both monotone along that chain, so the
+        earliest chain event with ``anc(z, w)`` is the least likely to be
+        fork-poisoned — a binary search decides ∃-z exactly.  For forked
+        m, the few events are enumerated directly.
+        """
+        if self.forkseen(x, m):
+            return False  # x sees no event by m at all
+        cw = self.hg[w].c
+        a = self.anc[x]
+        if not self.has_fork[m]:
+            cnt = _bit_count(a & self.member_mask[m])
+            if not cnt:
+                return False
+            chain = self.member_chain[m]
+            if not self.in_anc(chain[cnt - 1], w):
+                return False
+            lo, hi = 0, cnt - 1
+            while lo < hi:
+                mid = (lo + hi) // 2
+                if self.in_anc(chain[mid], w):
+                    hi = mid
+                else:
+                    lo = mid + 1
+            return not self.forkseen(chain[lo], cw)
+        for z in self.member_events[m]:
+            if (
+                (a >> self.idx[z]) & 1
+                and self.in_anc(z, w)
+                and not self.forkseen(z, cw)
+            ):
+                return True
+        return False
 
     def strongly_sees(self, x: bytes, w: bytes) -> bool:
-        """x strongly sees w: supermajority of member stake has an event z
-        with (x sees z) and (z sees w)."""
-        r = self.round[w]
-        slot_bit = 1 << self.wit_slot[w]
-        cw = self.hg[w].c
+        """x strongly sees w: members holding a stake supermajority each
+        have an event z with (x sees z) and (z sees w) — the ∃-z rule,
+        exactly as documented in the module spec.  The batched device
+        pipeline computes the same relation as a per-member visibility
+        matmul (``tpu_swirld.tpu.pipeline``); parity tests pin the two."""
+        key = (x, w)
+        memo = self._ss_memo.get(key)
+        if memo is not None:
+            return memo
         amount = 0
-        tips = self._tips(x)
-        for m, tlist in tips.items():
-            if self.forkseen(x, m):
-                continue  # x cannot see any event by a forked-visible member
-            for z in tlist:
-                if self.compact[z].get(r, 0) & slot_bit and not self.forkseen(z, cw):
-                    amount += self.stake[m]
-                    break
-        return 3 * amount > 2 * self.tot_stake
+        for m in self.members:
+            if self._sees_through(x, w, m):
+                amount += self.stake[m]
+        result = 3 * amount > 2 * self.tot_stake
+        self._ss_memo[key] = result
+        return result
 
     # ---------------------------------------------------------------- gossip
 
@@ -349,19 +366,33 @@ class Node:
     # ------------------------------------------------------------- consensus
 
     def _register_witness(self, eid: bytes, r: int) -> None:
+        if r <= self._frozen_round:
+            # Ancient-horizon prune: a witness landing in a fame-complete
+            # round is quarantined — excluded from witness tables, fame
+            # voting, and promotion tallies — so the node keeps running
+            # when a lagging member's old events arrive late (fame needs
+            # only a >2/3 quorum, so this is legitimate traffic).  The
+            # horizon is a node-local cut: in the adversarial corner where
+            # such a witness would have been *pivotal* for a later event's
+            # round promotion, nodes that saw it in time may assign that
+            # event a different round.  Full-closure gossip makes that
+            # corner unreachable without >1/3 stake being partitioned
+            # (outside the BFT liveness model); a consensus-agreed expiry
+            # horizon would close it entirely and is future work.  Batch
+            # passes (and the device pipeline) never freeze mid-pass, so
+            # the bit-parity contract is unaffected.
+            self.is_witness[eid] = True
+            self.ancient.append(eid)
+            return
         self.is_witness[eid] = True
         slots = self.wit_list.setdefault(r, [])
+        # slot order (insertion order) is load-bearing: decide_fame scans
+        # wit_list in slot order and the device pipeline mirrors it.
         self.wit_slot[eid] = len(slots)
         slots.append(eid)
         self.witnesses.setdefault(r, {}).setdefault(self.hg[eid].c, []).append(eid)
         self.famous[eid] = None
         self._next_vote_round[eid] = r + 1
-        self.compact[eid][r] = self.compact[eid].get(r, 0) | (1 << self.wit_slot[eid])
-        if r <= self._frozen_round:
-            raise AssertionError(
-                f"witness appeared in already-frozen round {r}; "
-                "straggler beyond the freeze horizon breaks batch parity"
-            )
 
     def divide_rounds(self, new_ids: Iterable[bytes]) -> None:
         """Assign round numbers and witness flags to ``new_ids`` (topo order).
@@ -372,16 +403,10 @@ class Node:
             ev = self.hg[eid]
             if not ev.p:
                 self.round[eid] = 0
-                self.compact[eid] = {}
                 self._register_witness(eid, 0)
                 continue
             sp, op = ev.p
             r = max(self.round[sp], self.round[op])
-            # merge ancestor-witness slot masks from parents
-            comp: Dict[int, int] = dict(self.compact[sp])
-            for rr, mask in self.compact[op].items():
-                comp[rr] = comp.get(rr, 0) | mask
-            self.compact[eid] = comp
             # promotion: strongly-seen round-r witnesses, distinct creators
             amount = 0
             for c, wids in self.witnesses.get(r, {}).items():
