@@ -1,0 +1,98 @@
+"""Packing layer: dense arrays must faithfully mirror the oracle's DAG."""
+
+import numpy as np
+
+from tpu_swirld.packing import pack_node
+from tpu_swirld.sim import make_simulation, run_with_forkers
+
+
+def closure_from_parents(parents: np.ndarray) -> np.ndarray:
+    """Reference reflexive-transitive closure (slow host loop)."""
+    n = parents.shape[0]
+    anc = np.zeros((n, n), dtype=bool)
+    for i in range(n):
+        anc[i, i] = True
+        for p in parents[i]:
+            if p >= 0:
+                anc[i] |= anc[p]
+    return anc
+
+
+def test_pack_node_mirrors_oracle():
+    sim = make_simulation(4, seed=3)
+    sim.run(120)
+    node = sim.nodes[0]
+    packed = pack_node(node)
+
+    assert packed.n == len(node.order_added)
+    for i, eid in enumerate(node.order_added):
+        ev = node.hg[eid]
+        assert packed.ids[i] == eid
+        assert packed.creator[i] == node.member_index[ev.c]
+        assert packed.seq[i] == node.seq[eid]
+        assert packed.t[i] == ev.t
+        assert packed.coin[i] == (ev.coin_bit() & 1)
+        if ev.p:
+            assert packed.parents[i, 0] == node.idx[ev.p[0]]
+            assert packed.parents[i, 1] == node.idx[ev.p[1]]
+        else:
+            assert tuple(packed.parents[i]) == (-1, -1)
+
+    # parents strictly before children (topo order invariant)
+    idxs = np.arange(packed.n)
+    assert (packed.parents < idxs[:, None]).all()
+
+    # ancestor closure from packed parents == oracle bitmasks
+    anc = closure_from_parents(packed.parents)
+    for i, eid in enumerate(node.order_added):
+        mask = node.anc[eid]
+        bits = np.array([(mask >> j) & 1 for j in range(packed.n)], dtype=bool)
+        assert (anc[i] == bits).all()
+
+    # member table covers each member's events in order
+    for ci, m in enumerate(node.members):
+        want = [node.idx[e] for e in node.member_events[m]]
+        got = [int(v) for v in packed.member_table[ci] if v >= 0]
+        assert got == want
+
+
+def test_pack_fork_pairs_match_oracle_groups():
+    sim = run_with_forkers(n_nodes=7, n_forkers=2, n_turns=200, seed=9)
+    # find a node that saw a fork
+    node = next(
+        n for n in sim.nodes if any(n.has_fork[m] for m in sim.members)
+    )
+    packed = pack_node(node)
+    want = set()
+    for m in node.members:
+        ci = node.member_index[m]
+        for _seq, ids in node.fork_groups[m].items():
+            idxs = sorted(node.idx[e] for e in ids)
+            for a_i in range(len(idxs)):
+                for b_i in range(a_i + 1, len(idxs)):
+                    want.add((ci, idxs[a_i], idxs[b_i]))
+    got = {(int(r[0]), int(r[1]), int(r[2])) for r in packed.fork_pairs}
+    assert got == want
+    assert len(want) > 0
+
+
+def test_incremental_append_equals_one_shot():
+    sim = make_simulation(4, seed=1)
+    sim.run(60)
+    node = sim.nodes[1]
+    from tpu_swirld.packing import Packer, pack_events
+
+    stake = [node.stake[m] for m in node.members]
+    inc = Packer(node.members, stake)
+    # append in two batches, with idempotent re-append of the first half
+    events = [node.hg[e] for e in node.order_added]
+    half = len(events) // 2
+    inc.extend(events[:half])
+    inc.extend(events[:half])      # idempotent
+    inc.extend(events)             # completes the rest
+    a = inc.pack()
+    b = pack_events(events, node.members, stake)
+    assert a.n == b.n
+    for field in ("parents", "creator", "seq", "t", "coin", "member_table"):
+        assert (getattr(a, field) == getattr(b, field)).all()
+    assert a.ids == b.ids

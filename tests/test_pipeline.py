@@ -1,0 +1,109 @@
+"""Bit-parity: the device pipeline must match the oracle exactly.
+
+BASELINE.json north star: identical ``round`` / ``witness`` / ``famous`` /
+consensus order.  Each test packs a seeded oracle sim and compares every
+output, no tolerance.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_swirld.packing import pack_node
+from tpu_swirld.sim import make_simulation, run_with_forkers
+from tpu_swirld.tpu.pipeline import run_consensus
+
+
+def assert_parity(node, packed, result):
+    # precondition: the live node must not have quarantined any straggler
+    # witness — the batch pipeline never freezes mid-pass, so parity is
+    # only promised for quarantine-free histories.
+    assert not node.ancient, "sim produced a quarantined witness; pick a new seed"
+    # rounds + witness flags, every event
+    for i, eid in enumerate(node.order_added):
+        assert result.round[i] == node.round[eid], (
+            f"round mismatch at {i}: {result.round[i]} != {node.round[eid]}"
+        )
+        assert bool(result.is_witness[i]) == bool(node.is_witness[eid]), (
+            f"witness mismatch at {i}"
+        )
+    # fame: over all registered witnesses
+    oracle_famous = {
+        node.idx[w]: node.famous[w]
+        for r, ws in node.wit_list.items()
+        for w in ws
+    }
+    assert result.famous == oracle_famous
+    # round received + consensus timestamps for ordered events
+    for pos, eid in enumerate(node.consensus):
+        i = node.idx[eid]
+        assert result.round_received[i] == node.round_received[eid]
+        assert result.consensus_ts[i] == node.consensus_ts[eid]
+    # the total order itself
+    got = [packed.ids[i] for i in result.order]
+    assert got == node.consensus
+
+
+def run_parity(sim_nodes, turns, seed, forkers=0):
+    if forkers:
+        sim = run_with_forkers(sim_nodes, forkers, turns, seed=seed)
+    else:
+        sim = make_simulation(sim_nodes, seed=seed)
+        sim.run(turns)
+    node = sim.nodes[0]
+    packed = pack_node(node)
+    result = run_consensus(packed, node.config, block=64)
+    assert_parity(node, packed, result)
+    assert len(node.consensus) > 0, "test must exercise a non-trivial order"
+    return sim, node, result
+
+
+def test_parity_config1_small():
+    """BASELINE config 1 shape: 4-member reference sim."""
+    run_parity(4, 200, seed=0)
+
+
+def test_parity_config1_other_seeds():
+    run_parity(4, 250, seed=7)
+    run_parity(5, 250, seed=11)
+
+
+def test_parity_16_members():
+    """BASELINE config 2 shape (16 members), reduced turns for CI speed."""
+    sim, node, result = run_parity(16, 400, seed=2)
+    assert result.max_round >= 2
+
+
+def test_parity_with_forkers():
+    """Fork-aware pipeline: parity on a DAG containing real fork pairs."""
+    sim = run_with_forkers(n_nodes=7, n_forkers=2, n_turns=300, seed=9)
+    node = next(
+        n for n in sim.nodes if any(n.has_fork[m] for m in sim.members)
+    )
+    packed = pack_node(node)
+    assert len(packed.fork_pairs) > 0
+    result = run_consensus(packed, node.config, block=64)
+    assert_parity(node, packed, result)
+
+
+def test_parity_weighted_stake():
+    from tpu_swirld.config import SwirldConfig
+    from tpu_swirld.sim import make_simulation
+
+    cfg = SwirldConfig(n_members=5, stake=(3, 1, 1, 1, 1), seed=4)
+    sim = make_simulation(5, seed=4, config=cfg)
+    sim.run(250)
+    node = sim.nodes[0]
+    packed = pack_node(node)
+    result = run_consensus(packed, node.config, block=64)
+    assert_parity(node, packed, result)
+
+
+@pytest.mark.slow
+def test_parity_config2_full():
+    """Full BASELINE config 2: 16 members / 2k events."""
+    sim = make_simulation(16, seed=2)
+    sim.run_until_events(2000)
+    node = max(sim.nodes, key=lambda n: len(n.hg))
+    packed = pack_node(node)
+    result = run_consensus(packed, node.config, block=128)
+    assert_parity(node, packed, result)

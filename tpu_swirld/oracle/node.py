@@ -293,6 +293,8 @@ class Node:
         exactly as documented in the module spec.  The batched device
         pipeline computes the same relation as a per-member visibility
         matmul (``tpu_swirld.tpu.pipeline``); parity tests pin the two."""
+        if not self.in_anc(x, w):
+            return False  # any valid z implies w is an ancestor of x
         key = (x, w)
         memo = self._ss_memo.get(key)
         if memo is not None:

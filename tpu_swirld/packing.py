@@ -1,0 +1,166 @@
+"""Dense packing: hash-addressed event DAG -> index arrays for the device.
+
+SURVEY.md §7 step 2 / BASELINE.json north star: events and their parent
+pointers are packed into a dense ``(N, 2)`` int32 index array plus creator /
+seq / timestamp / coin-bit vectors in topological (insertion) order.  The
+packer is append-only and incremental: gossip-sync deltas append to the same
+:class:`Packer`, and :meth:`Packer.pack` snapshots the arrays the pipeline
+consumes (``tpu_swirld.tpu.pipeline``).
+
+Everything here is host-side numpy — the device never touches hashes.  The
+hash <-> index mapping (``ids``) and the raw signatures (``sigs``, for the
+order-extraction whitening hash) stay on the host.
+
+Fork bookkeeping: the oracle detects forks per ``(creator, seq)`` group
+(minimal fork pairs always share them — see the spec block in
+``tpu_swirld.oracle.node``).  The packer mirrors that: every unordered pair
+of distinct events by one creator at one seq becomes a ``fork_pairs`` row
+``(member, idx_a, idx_b)``; the device computes ``forkseen[x, m]`` as an OR
+of ``anc[x, a] & anc[x, b]`` over that member's rows.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tpu_swirld.oracle.event import Event
+
+
+@dataclasses.dataclass
+class PackedDAG:
+    """Snapshot of a packed event DAG (topo order, genesis parents = -1)."""
+
+    n: int                     # number of events
+    n_members: int
+    parents: np.ndarray        # int32[N, 2]; -1 for genesis
+    creator: np.ndarray        # int32[N]; member index
+    seq: np.ndarray            # int32[N]; self-chain height
+    t: np.ndarray              # int64[N]; creation timestamps
+    coin: np.ndarray           # uint8[N]; signature middle bit (coin rounds)
+    stake: np.ndarray          # int32[M]
+    fork_pairs: np.ndarray     # int32[G, 3]: (member, idx_a, idx_b)
+    member_table: np.ndarray   # int32[M, K]: event idx per member, -1 pad
+    ids: List[bytes]           # event id per index (host only)
+    sigs: List[bytes]          # signature per index (host only)
+
+    @property
+    def max_events_per_member(self) -> int:
+        return self.member_table.shape[1]
+
+    def index_of(self, eid: bytes) -> int:
+        return self.ids.index(eid)
+
+
+class Packer:
+    """Append-only incremental packer (one per consensus engine instance)."""
+
+    def __init__(self, members: Sequence[bytes], stake: Sequence[int]):
+        if len(members) != len(stake):
+            raise ValueError("members and stake length mismatch")
+        self.members: List[bytes] = list(members)
+        self.member_index: Dict[bytes, int] = {m: i for i, m in enumerate(members)}
+        self.stake = np.asarray(stake, dtype=np.int32)
+        self.idx: Dict[bytes, int] = {}         # event id -> index
+        self._parents: List[Tuple[int, int]] = []
+        self._creator: List[int] = []
+        self._seq: List[int] = []
+        self._t: List[int] = []
+        self._coin: List[int] = []
+        self._ids: List[bytes] = []
+        self._sigs: List[bytes] = []
+        self._member_events: List[List[int]] = [[] for _ in members]
+        self._by_seq: List[Dict[int, List[int]]] = [{} for _ in members]
+        self._fork_pairs: List[Tuple[int, int, int]] = []
+
+    def __len__(self) -> int:
+        return len(self._ids)
+
+    def append(self, ev: Event) -> int:
+        """Pack one event (parents must already be packed).  Idempotent."""
+        eid = ev.id
+        existing = self.idx.get(eid)
+        if existing is not None:
+            return existing
+        ci = self.member_index.get(ev.c)
+        if ci is None:
+            raise ValueError("unknown creator")
+        i = len(self._ids)
+        if ev.p:
+            sp = self.idx.get(ev.p[0])
+            op = self.idx.get(ev.p[1])
+            if sp is None or op is None:
+                raise ValueError("parent not packed (append in topo order)")
+            seq = self._seq[sp] + 1
+            self._parents.append((sp, op))
+        else:
+            seq = 0
+            self._parents.append((-1, -1))
+        self.idx[eid] = i
+        self._creator.append(ci)
+        self._seq.append(seq)
+        self._t.append(int(ev.t))
+        self._coin.append(ev.coin_bit() & 1)
+        self._ids.append(eid)
+        self._sigs.append(ev.s)
+        self._member_events[ci].append(i)
+        group = self._by_seq[ci].setdefault(seq, [])
+        for other in group:            # every prior same-(creator, seq) event
+            self._fork_pairs.append((ci, other, i))
+        group.append(i)
+        return i
+
+    def extend(self, events: Iterable[Event]) -> List[int]:
+        return [self.append(ev) for ev in events]
+
+    def pack(self) -> PackedDAG:
+        n = len(self._ids)
+        m = len(self.members)
+        k = max((len(ev) for ev in self._member_events), default=0)
+        k = max(k, 1)
+        member_table = np.full((m, k), -1, dtype=np.int32)
+        for ci, evs in enumerate(self._member_events):
+            member_table[ci, : len(evs)] = evs
+        fork_pairs = (
+            np.asarray(self._fork_pairs, dtype=np.int32).reshape(-1, 3)
+            if self._fork_pairs
+            else np.zeros((0, 3), dtype=np.int32)
+        )
+        return PackedDAG(
+            n=n,
+            n_members=m,
+            parents=np.asarray(self._parents, dtype=np.int32).reshape(n, 2),
+            creator=np.asarray(self._creator, dtype=np.int32),
+            seq=np.asarray(self._seq, dtype=np.int32),
+            t=np.asarray(self._t, dtype=np.int64),
+            coin=np.asarray(self._coin, dtype=np.uint8),
+            stake=self.stake.copy(),
+            fork_pairs=fork_pairs,
+            member_table=member_table,
+            ids=list(self._ids),
+            sigs=list(self._sigs),
+        )
+
+
+def pack_events(
+    events: Sequence[Event],
+    members: Sequence[bytes],
+    stake: Optional[Sequence[int]] = None,
+) -> PackedDAG:
+    """Pack a topologically ordered event sequence in one shot."""
+    if stake is None:
+        stake = [1] * len(members)
+    p = Packer(members, stake)
+    p.extend(events)
+    return p.pack()
+
+
+def pack_node(node) -> PackedDAG:
+    """Pack an oracle :class:`~tpu_swirld.oracle.node.Node`'s full DAG in its
+    insertion (topo) order — the order its own consensus state was built in,
+    which the parity tests compare against."""
+    events = [node.hg[eid] for eid in node.order_added]
+    stake = [node.stake[m] for m in node.members]
+    return pack_events(events, node.members, stake)

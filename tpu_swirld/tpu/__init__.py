@@ -1,0 +1,5 @@
+"""Batched JAX/XLA consensus pipeline (the TPU execution backend)."""
+
+from tpu_swirld.tpu.pipeline import ConsensusResult, consensus_arrays, run_consensus
+
+__all__ = ["ConsensusResult", "consensus_arrays", "run_consensus"]

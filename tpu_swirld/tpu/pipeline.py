@@ -1,0 +1,696 @@
+"""The batched device consensus pipeline (JAX / XLA).
+
+This is the TPU-native replacement for the oracle's per-event recursion
+(``Node.divide_rounds`` / ``decide_fame`` / ``find_order`` — SURVEY.md §2
+#6-8, BASELINE.json north star).  It consumes a :class:`~tpu_swirld.packing.
+PackedDAG` and produces **bit-identical** ``round`` / ``is_witness`` /
+``famous`` / ``(round_received, consensus_ts)`` outputs; the final total
+order additionally applies the signature-whitened hash tiebreak, which is a
+host-side byte operation (``run_consensus``).
+
+Phase structure (each phase a pure jittable function; ``consensus_arrays``
+fuses them into one jit for the end-to-end device step):
+
+1. ``ancestry`` — reflexive-transitive parent closure as a *blockwise*
+   boolean matmul: events are processed in topological blocks; each block's
+   internal closure is log2(B) squarings of a B×B adjacency (MXU), then one
+   (B×B)@(B×N) matmul propagates the external parent rows.  This is the
+   "tiled boolean matrix-power reachability" kernel of SURVEY §5.
+2. ``forkseen_matrix`` / ``sees_matrix`` — fork-aware visibility.  Fork
+   pairs (same creator+seq, packed on host) poison descendants: ``sees(x,y)
+   = anc(x,y) & ~forkseen(x, creator(y))``.
+3. ``ssm_matrix`` — strongly-sees via the ∃-z member hop: per member m,
+   ``hit_m = (S[:, events_m] @ S[events_m, :]) > 0``; stake-weighted count
+   of hitting members crosses the strict-2/3 integer threshold.  Exactly
+   the oracle's ``strongly_sees`` (∃-z rule).
+4. ``rounds_scan`` — ``lax.scan`` over events in topo order carrying the
+   round->witness-slot table: round = max(parent rounds) + promotion,
+   witness = first-of-creator-in-round.
+5. ``fame_scan`` — ``lax.scan`` over rounds carrying the previous round's
+   vote matrix: direct votes at distance 1, stake tallies over strongly-
+   seen previous-round witnesses (per-creator OR when forks exist), coin
+   rounds take the packed signature middle bit; fame is decided by the
+   chronologically first supermajority in a non-coin round.
+6. ``order_scan`` — per fame-complete round: unique famous witnesses, the
+   all-UFW ancestry test for round-received, and a self-parent chain walk
+   producing each UFW's earliest-seeing timestamp; consensus timestamp is
+   the lower median.
+
+All supermajorities are exact integer tests ``3*amount > 2*total``.  The
+device stays int32-pure: int64 timestamps are dense-ranked on the host
+(equal timestamps -> equal ranks, so lower-median selection is exact) and
+the median *rank* is mapped back to the int64 value after the kernel.  Bool
+matmuls run in ``matmul_dtype`` (bfloat16 on TPU — products are 0/1 and the
+MXU accumulates in f32, so counts below 2^24 are exact; float32 on CPU) and
+threshold at 0.5.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_swirld import crypto
+from tpu_swirld.config import SwirldConfig
+from tpu_swirld.oracle.node import xor_bytes
+from tpu_swirld.packing import PackedDAG
+
+INT32_MAX = np.iinfo(np.int32).max
+
+
+def default_matmul_dtype():
+    return jnp.float32 if jax.default_backend() == "cpu" else jnp.bfloat16
+
+
+def _bmm(a: jnp.ndarray, b: jnp.ndarray, dtype) -> jnp.ndarray:
+    """Boolean matmul: OR over products of 0/1 values (exact: f32 accum)."""
+    return (
+        jnp.matmul(
+            a.astype(dtype), b.astype(dtype), preferred_element_type=jnp.float32
+        )
+        > 0.5
+    )
+
+
+# --------------------------------------------------------------- phase 1
+
+
+def ancestry(parents: jnp.ndarray, *, block: int, matmul_dtype) -> jnp.ndarray:
+    """Reflexive-transitive closure of the parent relation.
+
+    ``parents`` int32[N, 2] with -1 for genesis, topologically ordered
+    (parents strictly below), N a multiple of ``block``.  Returns bool[N, N]
+    with ``anc[i, j]`` = "j is an ancestor of i" (reflexive).
+    """
+    n = parents.shape[0]
+    assert n % block == 0, "pad N to a multiple of block"
+    n_blocks = n // block
+    n_sq = max(1, math.ceil(math.log2(block)))
+
+    eye = jnp.eye(block, dtype=bool)
+    jj = jnp.arange(block)
+
+    def body(k, r):
+        s = k * block
+        pb = lax.dynamic_slice(parents, (s, 0), (block, 2))      # B,2
+        local = pb - s                                           # in-block offset
+        adj = (local[:, 0:1] == jj[None, :]) | (local[:, 1:2] == jj[None, :])
+        lc = adj | eye
+        for _ in range(n_sq):                                    # static unroll
+            lc = lc | _bmm(lc, lc, matmul_dtype)
+        pc = jnp.clip(pb, 0, n - 1)
+        ext = pb >= 0                                            # external iff < s,
+        ext = ext & (pb < s)                                     # in-block handled by lc
+        g = (r[pc[:, 0]] & ext[:, 0:1]) | (r[pc[:, 1]] & ext[:, 1:2])   # B,N
+        rows = _bmm(lc, g, matmul_dtype)                         # B,N
+        diag = lax.dynamic_slice(rows, (0, s), (block, block)) | lc
+        rows = lax.dynamic_update_slice(rows, diag, (0, s))
+        return lax.dynamic_update_slice(r, rows, (s, 0))
+
+    r0 = jnp.zeros((n, n), dtype=bool)
+    return lax.fori_loop(0, n_blocks, body, r0)
+
+
+# --------------------------------------------------------------- phase 2
+
+
+def forkseen_matrix(
+    anc: jnp.ndarray, fork_pairs: jnp.ndarray, n_members: int, matmul_dtype
+) -> jnp.ndarray:
+    """bool[N, M]: does x have a fork pair by member m among its ancestors?
+
+    ``fork_pairs`` int32[G, 3] rows (member, idx_a, idx_b); G may include
+    padding rows with member = -1.
+    """
+    n = anc.shape[0]
+    if fork_pairs.shape[0] == 0:
+        return jnp.zeros((n, n_members), dtype=bool)
+    mcol = fork_pairs[:, 0]
+    a = jnp.clip(fork_pairs[:, 1], 0, n - 1)
+    b = jnp.clip(fork_pairs[:, 2], 0, n - 1)
+    hit = anc[:, a] & anc[:, b] & (mcol >= 0)[None, :]           # N,G
+    onehot = mcol[:, None] == jnp.arange(n_members)[None, :]     # G,M
+    return _bmm(hit, onehot, matmul_dtype)
+
+
+def sees_matrix(
+    anc: jnp.ndarray, forkseen: jnp.ndarray, creator: jnp.ndarray
+) -> jnp.ndarray:
+    """Fork-aware visibility: sees(x, y) = anc(x, y) & ~forkseen(x, c(y))."""
+    return anc & ~forkseen[:, creator]
+
+
+# --------------------------------------------------------------- phase 3
+
+
+def ssm_matrix(
+    sees: jnp.ndarray,
+    member_table: jnp.ndarray,
+    stake: jnp.ndarray,
+    tot_stake: int,
+    matmul_dtype,
+) -> jnp.ndarray:
+    """Strongly-sees matrix (∃-z rule): bool[N, N].
+
+    ``ssm[x, w]`` = members holding a strict 2/3 stake supermajority each
+    have an event z with sees(x, z) and sees(z, w).
+    """
+    n = sees.shape[0]
+    n_members, k = member_table.shape
+
+    def body(m, acc):
+        idx = member_table[m]                        # K
+        valid = idx >= 0
+        idxc = jnp.clip(idx, 0, n - 1)
+        a = sees[:, idxc] & valid[None, :]           # N,K  (x sees z)
+        b = sees[idxc, :] & valid[:, None]           # K,N  (z sees w)
+        hit = _bmm(a, b, matmul_dtype)               # N,N
+        return acc + stake[m] * hit.astype(jnp.int32)
+
+    acc = lax.fori_loop(0, n_members, body, jnp.zeros((n, n), dtype=jnp.int32))
+    return 3 * acc > 2 * tot_stake
+
+
+# --------------------------------------------------------------- phase 4
+
+
+def rounds_scan(
+    parents: jnp.ndarray,
+    ssm: jnp.ndarray,
+    creator: jnp.ndarray,
+    stake: jnp.ndarray,
+    tot_stake: int,
+    n_valid: jnp.ndarray,
+    *,
+    r_max: int,
+    s_max: int,
+    has_forks: bool,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Round assignment + witness registration (topo-order scan).
+
+    Returns (round int32[N], is_witness bool[N], wit_table int32[r_max,
+    s_max], wit_count int32[r_max], overflow bool[]).  Slot order within a
+    round is registration (= topo) order, as in the oracle.
+    """
+    n = parents.shape[0]
+    n_members = stake.shape[0]
+    marange = jnp.arange(n_members)
+
+    def step(carry, i):
+        rnd, tab, cnt, overflow = carry
+        p1 = parents[i, 0]
+        p2 = parents[i, 1]
+        genesis = p1 < 0
+        p1c = jnp.maximum(p1, 0)
+        p2c = jnp.maximum(p2, 0)
+        r0 = jnp.maximum(rnd[p1c], rnd[p2c])
+        r0c = jnp.clip(r0, 0, r_max - 1)
+        widx = tab[r0c]                                     # S
+        wvalid = widx >= 0
+        widxc = jnp.clip(widx, 0, n - 1)
+        ss = ssm[i, widxc] & wvalid                         # S
+        if has_forks:
+            wcre = creator[widxc]
+            contrib = ((wcre[:, None] == marange[None, :]) & ss[:, None]).any(0)
+            amount = jnp.sum(stake * contrib)
+        else:
+            # no forks packed -> at most one witness per (creator, round)
+            amount = jnp.sum(stake[creator[widxc]] * ss)
+        promoted = 3 * amount > 2 * tot_stake
+        r = jnp.where(genesis, 0, r0 + promoted)
+        is_wit = (genesis | (r > rnd[p1c])) & (i < n_valid)
+        overflow = overflow | (is_wit & (r >= r_max))
+        rc = jnp.clip(r, 0, r_max - 1)
+        slot = cnt[rc]
+        overflow = overflow | (is_wit & (slot >= s_max))
+        do = is_wit & (slot < s_max) & (r < r_max)
+        slotc = jnp.clip(slot, 0, s_max - 1)
+        tab = tab.at[rc, slotc].set(jnp.where(do, i, tab[rc, slotc]))
+        cnt = cnt.at[rc].add(do.astype(jnp.int32))
+        rnd = rnd.at[i].set(jnp.where(i < n_valid, r, 0))
+        return (rnd, tab, cnt, overflow), (r, is_wit)
+
+    carry0 = (
+        jnp.zeros((n,), dtype=jnp.int32),
+        jnp.full((r_max, s_max), -1, dtype=jnp.int32),
+        jnp.zeros((r_max,), dtype=jnp.int32),
+        jnp.zeros((), dtype=bool),
+    )
+    (rnd, tab, cnt, overflow), (rs, wits) = lax.scan(
+        step, carry0, jnp.arange(n)
+    )
+    return rnd, wits, tab, cnt, overflow
+
+
+# --------------------------------------------------------------- phase 5
+
+
+def fame_scan(
+    wit_table: jnp.ndarray,
+    sees: jnp.ndarray,
+    ssm: jnp.ndarray,
+    creator: jnp.ndarray,
+    coin: jnp.ndarray,
+    stake: jnp.ndarray,
+    tot_stake: int,
+    coin_period: int,
+    matmul_dtype,
+    *,
+    has_forks: bool,
+) -> jnp.ndarray:
+    """Virtual fame voting.  Returns famous int8[r_max*s_max] over global
+    witness slots (row-major (round, slot)): 1 famous, 0 not, -1 undecided.
+    """
+    r_max, s_max = wit_table.shape
+    n = sees.shape[0]
+    n_members = stake.shape[0]
+    w_max = r_max * s_max
+    # The fast tally multiplies stake values into a float32 matmul; that is
+    # exact only while every sum stays below 2^24.  Forks additionally need
+    # the per-creator OR.  Otherwise take the int32 per-creator path.
+    exact_tally = has_forks or tot_stake >= (1 << 24)
+
+    x_event = wit_table.reshape(-1)                     # W
+    x_valid = x_event >= 0
+    xe = jnp.clip(x_event, 0, n - 1)
+    x_round = jnp.arange(w_max, dtype=jnp.int32) // s_max
+    marange = jnp.arange(n_members)
+
+    def step(carry, ry):
+        v_prev, famous = carry                          # bool[S,W], int8[W]
+        y_idx = wit_table[ry]                           # S
+        y_valid = y_idx >= 0
+        ye = jnp.clip(y_idx, 0, n - 1)
+        d = ry - x_round                                # W
+        sees_yx = sees[ye][:, xe] & y_valid[:, None] & x_valid[None, :]
+        p_idx = wit_table[ry - 1]
+        p_valid = p_idx >= 0
+        pe = jnp.clip(p_idx, 0, n - 1)
+        ssy = ssm[ye][:, pe] & y_valid[:, None] & p_valid[None, :]   # S,S
+        pcre = creator[pe]                              # S
+        pstake = jnp.where(p_valid, stake[pcre], 0)
+        if exact_tally:
+            # per-creator OR before stake-weighting (forked creators may
+            # have several witnesses in round ry-1)
+            onehot = (pcre[:, None] == marange[None, :]) & p_valid[:, None]
+            w1 = (ssy[:, None, :] & onehot.T[None, :, :]).reshape(
+                s_max * n_members, s_max
+            )                                           # (S*M),S
+            yes_c = _bmm(w1, v_prev, matmul_dtype).reshape(
+                s_max, n_members, w_max
+            )
+            no_c = _bmm(w1, ~v_prev & p_valid[:, None], matmul_dtype).reshape(
+                s_max, n_members, w_max
+            )
+            yes = jnp.sum(yes_c * stake[None, :, None], axis=1)     # S,W int32
+            no = jnp.sum(no_c * stake[None, :, None], axis=1)
+        else:
+            sw = ssy * pstake[None, :]                  # S,S int32
+            yes = jnp.matmul(
+                sw.astype(jnp.float32),
+                v_prev.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            ).astype(jnp.int32)
+            no = jnp.matmul(
+                sw.astype(jnp.float32),
+                (~v_prev & p_valid[:, None]).astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            ).astype(jnp.int32)
+        v_tally = yes >= no                             # S,W
+        super_ = 3 * jnp.maximum(yes, no) > 2 * tot_stake
+        is_coin = (d % coin_period) == 0                # W
+        coin_y = (coin[ye] > 0)[:, None]                # S,1
+        vote = jnp.where(
+            (d == 1)[None, :],
+            sees_yx,
+            jnp.where(is_coin[None, :], jnp.where(super_, v_tally, coin_y), v_tally),
+        )
+        vote = vote & y_valid[:, None] & x_valid[None, :] & (d >= 1)[None, :]
+        eligible = (
+            super_
+            & y_valid[:, None]
+            & (x_valid & (d >= 2) & ~is_coin)[None, :]
+        )
+        any_dec = eligible.any(0)                       # W
+        first_y = jnp.argmax(eligible, axis=0)          # W
+        val = v_tally[first_y, jnp.arange(w_max)]
+        famous = jnp.where(
+            (famous < 0) & any_dec, val.astype(jnp.int8), famous
+        )
+        return (vote, famous), None
+
+    carry0 = (
+        jnp.zeros((s_max, w_max), dtype=bool),
+        jnp.full((w_max,), -1, dtype=jnp.int8),
+    )
+    (v_last, famous), _ = lax.scan(
+        step, carry0, jnp.arange(1, r_max, dtype=jnp.int32)
+    )
+    return famous
+
+
+# --------------------------------------------------------------- phase 6
+
+
+def order_scan(
+    anc: jnp.ndarray,
+    wit_table: jnp.ndarray,
+    wit_count: jnp.ndarray,
+    famous: jnp.ndarray,
+    creator: jnp.ndarray,
+    self_parent: jnp.ndarray,
+    t_rank: jnp.ndarray,
+    max_round: jnp.ndarray,
+    n_valid: jnp.ndarray,
+    *,
+    chain: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Round-received + consensus timestamp ranks.
+
+    Processes the maximal fame-complete prefix of rounds in ascending
+    order; an event is received in the first round whose unique famous
+    witnesses all have it as an ancestor; its consensus timestamp is the
+    lower median of the UFWs' earliest-seeing self-ancestor timestamps
+    (as dense ranks — the host maps ranks back to int64 values).
+    Returns (round_received int32[N] (-1 = not received), ts_rank int32[N]).
+    """
+    r_max, s_max = wit_table.shape
+    n = anc.shape[0]
+    famous_grid = famous.reshape(r_max, s_max)
+
+    wvalid = wit_table >= 0
+    decided = (famous_grid >= 0) | ~wvalid
+    complete = decided.all(axis=1) & (
+        max_round >= jnp.arange(r_max) + 2
+    ) & (wit_count > 0)
+    # maximal prefix of fame-complete rounds (cumulative AND)
+    prefix = jnp.cumprod(complete.astype(jnp.int32)) > 0
+
+    ev_valid = jnp.arange(n) < n_valid
+
+    def step(carry, r):
+        received, rr_out, ts_out = carry
+        widx = wit_table[r]
+        valid = widx >= 0
+        we = jnp.clip(widx, 0, n - 1)
+        fam = (famous_grid[r] == 1) & valid             # S
+        wcre = creator[we]
+        fam_per_creator = jnp.zeros((s_max,), jnp.int32)
+        # count famous witnesses per creator via pairwise same-creator sum
+        same = (wcre[:, None] == wcre[None, :]) & valid[:, None] & valid[None, :]
+        cnt_same = jnp.sum(same & fam[None, :], axis=1)  # S: per slot, count of
+        ufw = fam & (cnt_same == 1)                      # famous by same creator
+        has = ufw.any()
+        anc_rows = anc[we]                               # S,N
+        all_see = (anc_rows | ~ufw[:, None]).all(0)      # N
+        newly = (
+            all_see & ~received & prefix[r] & has & ev_valid
+        )
+        # earliest-seeing timestamps via self-chain walk (w -> genesis)
+        def walk(c2, _):
+            cur, tsw = c2
+            an = anc[cur]                                # S,N
+            tsw = jnp.where(an, t_rank[cur][:, None], tsw)
+            nxt = self_parent[cur]
+            cur = jnp.where(nxt >= 0, nxt, cur)
+            return (cur, tsw), None
+
+        ts0 = jnp.full((s_max, n), INT32_MAX, dtype=jnp.int32)
+        (cur, tsw), _ = lax.scan(walk, (we, ts0), None, length=chain)
+        tsw = jnp.where(ufw[:, None], tsw, INT32_MAX)    # mask non-UFW rows
+        ts_sorted = jnp.sort(tsw, axis=0)                # S,N ascending
+        nv = jnp.sum(ufw)
+        med_i = jnp.clip((nv - 1) // 2, 0, s_max - 1)
+        med = ts_sorted[med_i]                           # N
+        rr_out = jnp.where(newly, r, rr_out)
+        ts_out = jnp.where(newly, med, ts_out)
+        received = received | newly
+        return (received, rr_out, ts_out), None
+
+    carry0 = (
+        jnp.zeros((n,), dtype=bool),
+        jnp.full((n,), -1, dtype=jnp.int32),
+        jnp.zeros((n,), dtype=jnp.int32),
+    )
+    (received, rr_out, ts_out), _ = lax.scan(
+        step, carry0, jnp.arange(r_max, dtype=jnp.int32)
+    )
+    return rr_out, ts_out
+
+
+# ----------------------------------------------------------- fused kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "tot_stake",
+        "coin_period",
+        "block",
+        "r_max",
+        "s_max",
+        "chain",
+        "has_forks",
+        "matmul_dtype_name",
+    ),
+)
+def consensus_arrays(
+    parents,
+    creator,
+    t_rank,
+    coin,
+    stake,
+    fork_pairs,
+    member_table,
+    n_valid,
+    *,
+    tot_stake: int,
+    coin_period: int,
+    block: int,
+    r_max: int,
+    s_max: int,
+    chain: int,
+    has_forks: bool,
+    matmul_dtype_name: str,
+):
+    """End-to-end device consensus: packed arrays -> all consensus outputs.
+
+    One jit; the flagship entry point (``__graft_entry__.entry``).
+    """
+    dt = jnp.bfloat16 if matmul_dtype_name == "bfloat16" else jnp.float32
+    n_members = stake.shape[0]
+    anc = ancestry(parents, block=block, matmul_dtype=dt)
+    fseen = forkseen_matrix(anc, fork_pairs, n_members, dt)
+    sees = sees_matrix(anc, fseen, creator)
+    ssm = ssm_matrix(sees, member_table, stake, tot_stake, dt)
+    rnd, wits, tab, cnt, overflow = rounds_scan(
+        parents,
+        ssm,
+        creator,
+        stake,
+        tot_stake,
+        n_valid,
+        r_max=r_max,
+        s_max=s_max,
+        has_forks=has_forks,
+    )
+    famous = fame_scan(
+        tab,
+        sees,
+        ssm,
+        creator,
+        coin,
+        stake,
+        tot_stake,
+        coin_period,
+        dt,
+        has_forks=has_forks,
+    )
+    max_round = jnp.max(jnp.where(jnp.arange(rnd.shape[0]) < n_valid, rnd, 0))
+    rr, cts_rank = order_scan(
+        anc,
+        tab,
+        cnt,
+        famous,
+        creator,
+        parents[:, 0],
+        t_rank,
+        max_round,
+        n_valid,
+        chain=chain,
+    )
+    return {
+        "round": rnd,
+        "is_witness": wits,
+        "wit_table": tab,
+        "wit_count": cnt,
+        "famous": famous,
+        "round_received": rr,
+        "consensus_ts_rank": cts_rank,
+        "overflow": overflow,
+        "max_round": max_round,
+    }
+
+
+# ------------------------------------------------------- host orchestration
+
+
+@dataclasses.dataclass
+class ConsensusResult:
+    """Host-side view of the device outputs (indices into the PackedDAG)."""
+
+    n: int
+    round: np.ndarray            # int32[n]
+    is_witness: np.ndarray       # bool[n]
+    famous: Dict[int, Optional[bool]]   # witness idx -> fame (None undecided)
+    round_received: np.ndarray   # int32[n] (-1 not received)
+    consensus_ts: np.ndarray     # int64[n]
+    order: List[int]             # final total order (packed indices)
+    max_round: int
+
+
+def _pad_packed(packed: PackedDAG, block: int):
+    n = packed.n
+    n_pad = ((n + block - 1) // block) * block
+    pad = n_pad - n
+
+    def padi(a, fill):
+        if pad == 0:
+            return a
+        shape = (pad,) + a.shape[1:]
+        return np.concatenate([a, np.full(shape, fill, a.dtype)], axis=0)
+
+    parents = padi(packed.parents, -1)
+    creator = padi(packed.creator, 0)
+    seq = padi(packed.seq, 0)
+    t = padi(packed.t, 0)
+    coin = padi(packed.coin, 0)
+    return n_pad, parents, creator, seq, t, coin
+
+
+def run_consensus(
+    packed: PackedDAG,
+    config: Optional[SwirldConfig] = None,
+    *,
+    block: int = 128,
+    r_max: Optional[int] = None,
+    s_max: Optional[int] = None,
+    matmul_dtype_name: Optional[str] = None,
+) -> ConsensusResult:
+    """Run the full pipeline on a packed DAG and extract the final order.
+
+    The device computes everything except the tiebreak hash; the host
+    applies the oracle's exact sort key (round received, consensus ts,
+    BLAKE2b(whiten || id)) to produce the total order.
+    """
+    config = config or SwirldConfig(n_members=packed.n_members)
+    if matmul_dtype_name is None:
+        matmul_dtype_name = (
+            "float32" if jax.default_backend() == "cpu" else "bfloat16"
+        )
+    n = packed.n
+    n_pad, parents, creator, seq, t, coin = _pad_packed(packed, block)
+    extras = len(set(packed.fork_pairs[:, 2].tolist())) if len(packed.fork_pairs) else 0
+    if s_max is None:
+        s_max = packed.n_members + extras + 1
+    if r_max is None:
+        r_max = int(config.max_rounds)
+    chain = int(packed.seq.max()) + 1 if n else 1
+    tot = int(packed.stake.sum())
+    # dense-rank timestamps so the device stays int32-pure (see module doc)
+    ts_unique, t_rank = np.unique(t, return_inverse=True)
+    t_rank = t_rank.astype(np.int32).reshape(t.shape)
+
+    out = consensus_arrays(
+        jnp.asarray(parents),
+        jnp.asarray(creator),
+        jnp.asarray(t_rank),
+        jnp.asarray(coin),
+        jnp.asarray(packed.stake),
+        jnp.asarray(packed.fork_pairs),
+        jnp.asarray(packed.member_table),
+        jnp.asarray(n, dtype=jnp.int32),
+        tot_stake=tot,
+        coin_period=config.coin_period,
+        block=block,
+        r_max=r_max,
+        s_max=s_max,
+        chain=chain,
+        has_forks=bool(len(packed.fork_pairs)),
+        matmul_dtype_name=matmul_dtype_name,
+    )
+    out = jax.tree.map(np.asarray, out)
+    if bool(out["overflow"]):
+        raise RuntimeError(
+            "witness table overflow: raise config.max_rounds / s_max"
+        )
+    return finalize_order(packed, out, ts_unique)
+
+
+def finalize_order(
+    packed: PackedDAG, out: Dict[str, np.ndarray], ts_unique: np.ndarray
+) -> ConsensusResult:
+    """Host post-pass: fame dict, whitened tiebreak, final total order."""
+    n = packed.n
+    tab = out["wit_table"]
+    famous_grid = out["famous"].reshape(tab.shape)
+    famous: Dict[int, Optional[bool]] = {}
+    r_max, s_max = tab.shape
+    ufw_by_round: Dict[int, List[int]] = {}
+    for r in range(r_max):
+        fam_slots = []
+        for s in range(s_max):
+            e = int(tab[r, s])
+            if e < 0:
+                continue
+            f = int(famous_grid[r, s])
+            famous[e] = None if f < 0 else bool(f)
+            if f == 1:
+                fam_slots.append(e)
+        if fam_slots:
+            by_creator: Dict[int, List[int]] = {}
+            for e in fam_slots:
+                by_creator.setdefault(int(packed.creator[e]), []).append(e)
+            ufw_by_round[r] = sorted(
+                e for v in by_creator.values() if len(v) == 1 for e in v
+            )
+
+    rr = out["round_received"][:n]
+    # map timestamp ranks back to the int64 values
+    rank = np.clip(out["consensus_ts_rank"][:n], 0, len(ts_unique) - 1)
+    cts = np.where(rr >= 0, ts_unique[rank], 0).astype(np.int64)
+    whiten_cache: Dict[int, bytes] = {}
+
+    def whiten(r: int) -> bytes:
+        w = whiten_cache.get(r)
+        if w is None:
+            w = bytes(crypto.SIG_BYTES)
+            for e in ufw_by_round.get(r, []):
+                w = xor_bytes(w, packed.sigs[e])
+            whiten_cache[r] = w
+        return w
+
+    received = [
+        (int(rr[i]), int(cts[i]), crypto.hash_bytes(whiten(int(rr[i])) + packed.ids[i]), i)
+        for i in range(n)
+        if rr[i] >= 0
+    ]
+    received.sort(key=lambda item: (item[0], item[1], item[2]))
+    return ConsensusResult(
+        n=n,
+        round=out["round"][:n],
+        is_witness=out["is_witness"][:n],
+        famous=famous,
+        round_received=rr,
+        consensus_ts=cts,
+        order=[i for (_r, _t, _h, i) in received],
+        max_round=int(out["max_round"]),
+    )
