@@ -1,0 +1,139 @@
+"""Benchmark: events/sec to consensus-order, TPU pipeline vs CPU oracle.
+
+Driver contract: print ONE JSON line on stdout:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+value       = device-pipeline consensus throughput (events/sec)
+vs_baseline = speedup over the pure-Python oracle on the same machine
+              (BASELINE.json north star: >= 50x on 64 members / 10k events).
+
+All detail goes to stderr.  Environment knobs:
+    BENCH_MEMBERS (64)  BENCH_EVENTS (10000)  BENCH_ORACLE_EVENTS (2500)
+    BENCH_TPU_PROBE_TIMEOUT (300 s)  BENCH_FORCE_CPU (unset)
+
+The machine's sitecustomize registers an 'axon' TPU-tunnel PJRT platform
+whose initialization has been observed to hang indefinitely; we therefore
+probe it in a SUBPROCESS with a hard timeout and fall back to CPU (with the
+platform recorded in stderr) rather than hanging the driver.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+MEMBERS = int(os.environ.get("BENCH_MEMBERS", "64"))
+EVENTS = int(os.environ.get("BENCH_EVENTS", "10000"))
+ORACLE_EVENTS = int(os.environ.get("BENCH_ORACLE_EVENTS", "2500"))
+PROBE_TIMEOUT = float(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "300"))
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def probe_tpu() -> bool:
+    """Can the default (axon/TPU) backend initialize? Probe in a child
+    process under a hard timeout so a wedged PJRT init can't hang us."""
+    if os.environ.get("BENCH_FORCE_CPU"):
+        return False
+    code = (
+        "import jax; d = jax.devices(); "
+        "import jax.numpy as jnp; "
+        "x = jax.jit(lambda a: a @ a)(jnp.ones((128, 128), jnp.bfloat16)); "
+        "x.block_until_ready(); print(d[0].platform)"
+    )
+    try:
+        t0 = time.time()
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=PROBE_TIMEOUT,
+            capture_output=True,
+            text=True,
+        )
+        log(f"[probe] rc={r.returncode} in {time.time()-t0:.0f}s: "
+            f"{(r.stdout or r.stderr).strip().splitlines()[-1] if (r.stdout or r.stderr).strip() else ''}")
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        log(f"[probe] TPU backend init exceeded {PROBE_TIMEOUT:.0f}s — falling back to CPU")
+        return False
+
+
+def main():
+    tpu_ok = probe_tpu()
+    import jax
+
+    if not tpu_ok:
+        jax.config.update("jax_platforms", "cpu")
+    platform = jax.devices()[0].platform
+    log(f"[env] platform={platform} devices={len(jax.devices())}")
+
+    from tpu_swirld.oracle.node import Node
+    from tpu_swirld.packing import pack_events
+    from tpu_swirld.sim import generate_gossip_dag
+    from tpu_swirld.tpu.pipeline import run_consensus
+
+    n_events = EVENTS if tpu_ok else min(EVENTS, 4000)
+    t0 = time.time()
+    members, stake, events, keys = generate_gossip_dag(MEMBERS, n_events, seed=1)
+    log(f"[gen] {MEMBERS} members / {n_events} events in {time.time()-t0:.1f}s")
+
+    # ---- CPU oracle denominator (batch consensus pass over a prefix) ----
+    n_oracle = min(ORACLE_EVENTS, n_events)
+    node = Node(
+        sk=keys[0][1], pk=members[0], network={}, members=members,
+        clock=lambda: 0, create_genesis=False,
+    )
+    new_ids = [ev.id for ev in events[:n_oracle] if node.add_event(ev)]
+    t0 = time.time()
+    node.divide_rounds(new_ids)
+    node.decide_fame()
+    node.find_order()
+    t_oracle = time.time() - t0
+    oracle_evps = n_oracle / t_oracle
+    log(f"[oracle] {n_oracle} events in {t_oracle:.2f}s = {oracle_evps:.0f} ev/s "
+        f"(ordered {len(node.consensus)}, max_round {node.max_round})")
+
+    # ---- device pipeline (full DAG), parity-checked on the oracle prefix --
+    t0 = time.time()
+    packed_prefix = pack_events(events[:n_oracle], members, stake)
+    packed_full = pack_events(events, members, stake)
+    log(f"[pack] {time.time()-t0:.2f}s")
+
+    res_prefix = run_consensus(packed_prefix, node.config)
+    parity = (
+        [packed_prefix.ids[i] for i in res_prefix.order] == node.consensus
+        and all(
+            res_prefix.round[i] == node.round[e]
+            for i, e in enumerate(node.order_added)
+        )
+    )
+    log(f"[parity] prefix ({n_oracle} ev) order+rounds identical: {parity}")
+
+    t0 = time.time()
+    res = run_consensus(packed_full, node.config)
+    t_compile_and_run = time.time() - t0
+    t0 = time.time()
+    res = run_consensus(packed_full, node.config)
+    t_steady = time.time() - t0
+    pipe_evps = n_events / t_steady
+    log(f"[pipeline] first {t_compile_and_run:.2f}s, steady {t_steady:.2f}s = "
+        f"{pipe_evps:.0f} ev/s (ordered {len(res.order)}, max_round {res.max_round})")
+
+    speedup = pipe_evps / oracle_evps
+    out = {
+        "metric": (
+            f"events/sec to consensus-order @{n_events} events x {MEMBERS} "
+            f"members ({platform}); order parity={parity}"
+        ),
+        "value": round(pipe_evps, 1),
+        "unit": "events/s",
+        "vs_baseline": round(speedup, 2),
+    }
+    print(json.dumps(out), flush=True)
+    if not parity:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""Sharded pipeline: member-axis SPMD must be bit-identical to the oracle."""
+
+import jax
+import pytest
+
+from tpu_swirld.packing import pack_node
+from tpu_swirld.parallel import make_mesh
+from tpu_swirld.sim import make_simulation
+from tpu_swirld.tpu.pipeline import run_consensus
+
+from tests.test_pipeline import assert_parity
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_sharded_parity_8_members_8_devices():
+    sim = make_simulation(8, seed=21)
+    sim.run(400)
+    node = sim.nodes[0]
+    packed = pack_node(node)
+    mesh = make_mesh(8)
+    result = run_consensus(packed, node.config, block=64, mesh=mesh)
+    assert_parity(node, packed, result)
+    assert len(node.consensus) > 0
+
+
+@pytest.mark.skipif(len(jax.devices()) < 4, reason="needs 4 virtual devices")
+def test_sharded_parity_member_padding():
+    """6 members on a 4-device mesh: the member axis must be padded."""
+    sim = make_simulation(6, seed=13)
+    sim.run(300)
+    node = sim.nodes[2]
+    packed = pack_node(node)
+    mesh = make_mesh(4)
+    result = run_consensus(packed, node.config, block=64, mesh=mesh)
+    assert_parity(node, packed, result)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_sharded_matches_unsharded():
+    sim = make_simulation(5, seed=31)
+    sim.run(250)
+    node = sim.nodes[1]
+    packed = pack_node(node)
+    a = run_consensus(packed, node.config, block=64)
+    b = run_consensus(packed, node.config, block=64, mesh=make_mesh(8))
+    assert (a.round == b.round).all()
+    assert (a.is_witness == b.is_witness).all()
+    assert a.famous == b.famous
+    assert a.order == b.order

@@ -82,6 +82,7 @@ class Node:
         members: Sequence[bytes],
         config: Optional[SwirldConfig] = None,
         clock: Optional[Callable[[], int]] = None,
+        create_genesis: bool = True,
     ):
         self.config = config or SwirldConfig(n_members=len(members))
         if len(members) != self.config.n_members:
@@ -133,10 +134,12 @@ class Node:
         self.transactions: List[bytes] = []        # payloads in consensus order
         self.consensus_round = 0                   # next round to try ordering with
 
-        # genesis event for self
-        genesis = Event(d=b"", p=(), t=self._now(), c=pk).signed(sk)
-        self.add_event(genesis)
-        self.divide_rounds([genesis.id])
+        # genesis event for self (skipped for pure observers replaying a
+        # pre-built DAG that already contains this member's genesis)
+        if create_genesis:
+            genesis = Event(d=b"", p=(), t=self._now(), c=pk).signed(sk)
+            self.add_event(genesis)
+            self.divide_rounds([genesis.id])
 
     # ------------------------------------------------------------------ utils
 

@@ -1,0 +1,126 @@
+"""SPMD sharding of the consensus pipeline over a ``jax.sharding.Mesh``.
+
+SURVEY.md §7 step 6 / BASELINE config 5: the strongly-sees computation — the
+pipeline's FLOP bottleneck, Θ(N²·N) boolean-matmul work — is sharded over
+the **member axis**: each device owns M/D members, computes its members'
+∃-z visibility hops as local (N×K)@(K×N) matmuls, and the stake tallies are
+aggregated with ``lax.psum`` over the mesh (the "psum vote aggregation over
+the member axis" the survey pins).  Everything else (scans, fame, order)
+is cheap and runs replicated.
+
+Gossip stays a host-level concern exactly as in the reference's in-process
+network dict; within the mesh, consensus-state reductions ride ICI
+collectives inserted by XLA.
+
+Multi-host note: the same ``shard_map`` code runs unchanged over a
+multi-host mesh (``jax.distributed.initialize`` + a global device array);
+the member axis then spans hosts and the psum rides DCN between ICI
+domains.  The in-repo tests exercise an 8-device single-host mesh
+(``xla_force_host_platform_device_count``), which the driver's
+``dryrun_multichip`` hook replays.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_swirld.tpu.pipeline import _bmm, consensus_body
+
+MEMBER_AXIS = "members"
+
+_STATIC = (
+    "tot_stake",
+    "coin_period",
+    "block",
+    "r_max",
+    "s_max",
+    "chain",
+    "has_forks",
+    "matmul_dtype_name",
+)
+
+
+def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """A 1-D member-axis mesh over the first ``n_devices`` devices."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (MEMBER_AXIS,))
+
+
+def ssm_matrix_sharded(sees, member_table, stake, tot_stake, dtype, *, mesh):
+    """Member-sharded strongly-sees: local matmul hops + psum stake tally.
+
+    ``member_table`` rows and ``stake`` must be padded to a multiple of the
+    mesh size (pad rows -1 / stake 0 — they contribute nothing).
+    """
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(None, None), P(MEMBER_AXIS, None), P(MEMBER_AXIS)),
+        out_specs=P(None, None),
+    )
+    def f(s, mt, stk):
+        n = s.shape[0]
+
+        def body(m, acc):
+            idx = mt[m]
+            valid = idx >= 0
+            idxc = jnp.clip(idx, 0, n - 1)
+            a = s[:, idxc] & valid[None, :]
+            b = s[idxc, :] & valid[:, None]
+            hit = _bmm(a, b, dtype)
+            return acc + stk[m] * hit.astype(jnp.int32)
+
+        # the per-device partial tally varies over the member axis; mark the
+        # initial carry as varying so the fori_loop carry types line up
+        acc0 = lax.pcast(
+            jnp.zeros((n, n), dtype=jnp.int32), (MEMBER_AXIS,), to="varying"
+        )
+        acc = lax.fori_loop(0, mt.shape[0], body, acc0)
+        acc = lax.psum(acc, MEMBER_AXIS)
+        return 3 * acc > 2 * tot_stake
+
+    return f(sees, member_table, stake)
+
+
+_mesh_fns = {}
+
+
+def consensus_fn_for_mesh(mesh: Mesh):
+    """Jitted end-to-end consensus with the SSM phase sharded over ``mesh``."""
+    fn = _mesh_fns.get(mesh)
+    if fn is None:
+        def ssm_fn(sees, member_table, stake, tot_stake, dtype):
+            return ssm_matrix_sharded(
+                sees, member_table, stake, tot_stake, dtype, mesh=mesh
+            )
+
+        fn = functools.partial(jax.jit, static_argnames=_STATIC)(
+            functools.partial(consensus_body, ssm_fn=ssm_fn)
+        )
+        _mesh_fns[mesh] = fn
+    return fn
+
+
+def pad_members(member_table: np.ndarray, stake: np.ndarray, n_devices: int):
+    """Pad the member axis to a multiple of the mesh size (-1 rows, 0 stake)."""
+    m = member_table.shape[0]
+    m_pad = ((m + n_devices - 1) // n_devices) * n_devices
+    if m_pad == m:
+        return member_table, stake
+    extra = m_pad - m
+    member_table = np.concatenate(
+        [member_table, np.full((extra, member_table.shape[1]), -1, np.int32)]
+    )
+    stake = np.concatenate([stake, np.zeros((extra,), stake.dtype)])
+    return member_table, stake

@@ -170,3 +170,50 @@ def run_with_forkers(
         sim.step()
         adversary.maybe_fork()
     return sim
+
+
+def generate_gossip_dag(
+    n_members: int,
+    n_events: int,
+    seed: int = 0,
+    stake: Optional[List[int]] = None,
+):
+    """Directly synthesize a valid random-gossip DAG (no per-node stores).
+
+    Produces the same *shape* of history as the in-process sim — per-member
+    self-chains stitched by random cross-member other-parents — but in
+    O(n_events) work, so BASELINE configs 3+ (64 members / 10k events) can
+    be generated in seconds.  Used by ``bench.py`` and the graft entry.
+
+    Returns ``(members, stake, events, keys)`` with ``events`` in topo
+    order and ``keys`` the (pk, sk) pairs (so callers can build observer or
+    member nodes for the same population).
+    """
+    rng = random.Random(seed)
+    keys = [crypto.keypair(b"dag-%d-%d" % (seed, i)) for i in range(n_members)]
+    members = [pk for pk, _ in keys]
+    stake = list(stake) if stake is not None else [1] * n_members
+    events: List[Event] = []
+    heads: List[Event] = []
+    t = 0
+    for pk, sk in keys:
+        t += 1
+        ev = Event(d=b"", p=(), t=t, c=pk).signed(sk)
+        events.append(ev)
+        heads.append(ev)
+    while len(events) < n_events:
+        ci = rng.randrange(n_members)
+        pi = rng.randrange(n_members - 1)
+        if pi >= ci:
+            pi += 1
+        pk, sk = keys[ci]
+        t += 1
+        ev = Event(
+            d=b"tx:%d" % len(events),
+            p=(heads[ci].id, heads[pi].id),
+            t=t,
+            c=pk,
+        ).signed(sk)
+        events.append(ev)
+        heads[ci] = ev
+    return members, stake, events, keys

@@ -403,7 +403,6 @@ def order_scan(
         we = jnp.clip(widx, 0, n - 1)
         fam = (famous_grid[r] == 1) & valid             # S
         wcre = creator[we]
-        fam_per_creator = jnp.zeros((s_max,), jnp.int32)
         # count famous witnesses per creator via pairwise same-creator sum
         same = (wcre[:, None] == wcre[None, :]) & valid[:, None] & valid[None, :]
         cnt_same = jnp.sum(same & fam[None, :], axis=1)  # S: per slot, count of
@@ -449,20 +448,7 @@ def order_scan(
 # ----------------------------------------------------------- fused kernel
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "tot_stake",
-        "coin_period",
-        "block",
-        "r_max",
-        "s_max",
-        "chain",
-        "has_forks",
-        "matmul_dtype_name",
-    ),
-)
-def consensus_arrays(
+def consensus_body(
     parents,
     creator,
     t_rank,
@@ -480,17 +466,22 @@ def consensus_arrays(
     chain: int,
     has_forks: bool,
     matmul_dtype_name: str,
+    ssm_fn=None,
 ):
     """End-to-end device consensus: packed arrays -> all consensus outputs.
 
-    One jit; the flagship entry point (``__graft_entry__.entry``).
+    ``ssm_fn`` overrides the strongly-sees kernel (the FLOP bottleneck) —
+    ``tpu_swirld.parallel`` passes the mesh-sharded version.  Jittable.
     """
     dt = jnp.bfloat16 if matmul_dtype_name == "bfloat16" else jnp.float32
     n_members = stake.shape[0]
     anc = ancestry(parents, block=block, matmul_dtype=dt)
     fseen = forkseen_matrix(anc, fork_pairs, n_members, dt)
     sees = sees_matrix(anc, fseen, creator)
-    ssm = ssm_matrix(sees, member_table, stake, tot_stake, dt)
+    if ssm_fn is None:
+        ssm = ssm_matrix(sees, member_table, stake, tot_stake, dt)
+    else:
+        ssm = ssm_fn(sees, member_table, stake, tot_stake, dt)
     rnd, wits, tab, cnt, overflow = rounds_scan(
         parents,
         ssm,
@@ -540,6 +531,21 @@ def consensus_arrays(
     }
 
 
+consensus_arrays = functools.partial(
+    jax.jit,
+    static_argnames=(
+        "tot_stake",
+        "coin_period",
+        "block",
+        "r_max",
+        "s_max",
+        "chain",
+        "has_forks",
+        "matmul_dtype_name",
+    ),
+)(consensus_body)
+
+
 # ------------------------------------------------------- host orchestration
 
 
@@ -584,12 +590,16 @@ def run_consensus(
     r_max: Optional[int] = None,
     s_max: Optional[int] = None,
     matmul_dtype_name: Optional[str] = None,
+    mesh=None,
 ) -> ConsensusResult:
     """Run the full pipeline on a packed DAG and extract the final order.
 
     The device computes everything except the tiebreak hash; the host
     applies the oracle's exact sort key (round received, consensus ts,
-    BLAKE2b(whiten || id)) to produce the total order.
+    BLAKE2b(whiten || id)) to produce the total order.  With ``mesh`` (a
+    1-D member-axis ``jax.sharding.Mesh``), the strongly-sees phase is
+    sharded over the mesh with psum stake aggregation
+    (:mod:`tpu_swirld.parallel`).
     """
     config = config or SwirldConfig(n_members=packed.n_members)
     if matmul_dtype_name is None:
@@ -609,14 +619,25 @@ def run_consensus(
     ts_unique, t_rank = np.unique(t, return_inverse=True)
     t_rank = t_rank.astype(np.int32).reshape(t.shape)
 
-    out = consensus_arrays(
+    member_table, stake = packed.member_table, packed.stake
+    if mesh is None:
+        kernel = consensus_arrays
+    else:
+        from tpu_swirld.parallel import consensus_fn_for_mesh, pad_members
+
+        member_table, stake = pad_members(
+            member_table, stake, mesh.devices.size
+        )
+        kernel = consensus_fn_for_mesh(mesh)
+
+    out = kernel(
         jnp.asarray(parents),
         jnp.asarray(creator),
         jnp.asarray(t_rank),
         jnp.asarray(coin),
-        jnp.asarray(packed.stake),
+        jnp.asarray(stake),
         jnp.asarray(packed.fork_pairs),
-        jnp.asarray(packed.member_table),
+        jnp.asarray(member_table),
         jnp.asarray(n, dtype=jnp.int32),
         tot_stake=tot,
         coin_period=config.coin_period,
