@@ -107,3 +107,51 @@ def test_parity_config2_full():
     packed = pack_node(node)
     result = run_consensus(packed, node.config, block=128)
     assert_parity(node, packed, result)
+
+
+def test_parity_config4_shape_small():
+    """Config-4 adversary shape at reduced scale (12 members, 4 forkers):
+    fork trees deep enough to exercise fame + ordering parity."""
+    from tpu_swirld.oracle.node import Node
+    from tpu_swirld.packing import pack_events
+    from tpu_swirld.sim import generate_gossip_dag
+
+    members, stake, events, keys = generate_gossip_dag(
+        12, 1200, seed=4, n_forkers=4
+    )
+    packed = pack_events(events, members, stake)
+    assert len(packed.fork_pairs) > 0
+    node = Node(
+        sk=keys[0][1], pk=members[0], network={}, members=members,
+        clock=lambda: 0, create_genesis=False,
+    )
+    new_ids = [ev.id for ev in events if node.add_event(ev)]
+    node.consensus_pass(new_ids)
+    assert len(node.consensus) > 0, "fame/order must be exercised"
+    assert sum(node.has_fork[m] for m in members) > 0
+    result = run_consensus(packed, node.config)
+    assert_parity(node, packed, result)
+
+
+@pytest.mark.slow
+def test_parity_config4_64m_f21():
+    """BASELINE config 4: 64 members, f=21 forkers — fork-detection parity
+    at scale (reduced event count: the pure-Python oracle is the limiter)."""
+    from tpu_swirld.oracle.node import Node
+    from tpu_swirld.packing import pack_events
+    from tpu_swirld.sim import generate_gossip_dag
+
+    members, stake, events, keys = generate_gossip_dag(
+        64, 4000, seed=4, n_forkers=21
+    )
+    packed = pack_events(events, members, stake)
+    assert len(packed.fork_pairs) > 100
+    node = Node(
+        sk=keys[0][1], pk=members[0], network={}, members=members,
+        clock=lambda: 0, create_genesis=False,
+    )
+    new_ids = [ev.id for ev in events if node.add_event(ev)]
+    node.consensus_pass(new_ids)
+    result = run_consensus(packed, node.config)
+    assert_parity(node, packed, result)
+    assert sum(node.has_fork[m] for m in members) >= 15

@@ -1,0 +1,143 @@
+"""Checkpoint / resume of the packed DAG and consensus state.
+
+SURVEY.md §5: the reference keeps everything in RAM and dies with the
+process; the build owes save/restore.  Two granularities:
+
+- :func:`save_packed` / :func:`load_packed` — the dense device-input arrays
+  (plus host-side ids/sigs) as a single ``.npz``.  No pickle anywhere:
+  hashes and signatures are fixed-width, so they serialize as uint8
+  matrices; payload bytes are length-prefix packed.
+- :func:`save_node` / :func:`load_node` — full engine state via the wire
+  format: the event log in topo order (``encode_event`` blobs).  Restore
+  replays the log through validation + one batch consensus pass, which by
+  the purity of the consensus functions reconstructs bit-identical
+  ``round`` / ``witness`` / ``famous`` / order state; the node then
+  resumes gossiping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import struct
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from tpu_swirld import crypto
+from tpu_swirld.config import SwirldConfig
+from tpu_swirld.oracle.event import decode_event, encode_event
+from tpu_swirld.oracle.node import Node
+from tpu_swirld.packing import PackedDAG
+
+FORMAT_VERSION = 1
+
+
+def _pack_bytes_list(items: List[bytes]) -> np.ndarray:
+    """Length-prefixed flat uint8 array (no pickle)."""
+    blob = b"".join(struct.pack("<I", len(b)) + b for b in items)
+    return np.frombuffer(blob, dtype=np.uint8)
+
+
+def _unpack_bytes_list(arr: np.ndarray) -> List[bytes]:
+    blob = arr.tobytes()
+    out, off = [], 0
+    while off < len(blob):
+        (n,) = struct.unpack_from("<I", blob, off)
+        off += 4
+        out.append(blob[off : off + n])
+        off += n
+    return out
+
+
+def save_packed(path: str, packed: PackedDAG) -> None:
+    np.savez_compressed(
+        path,
+        format_version=FORMAT_VERSION,
+        n=packed.n,
+        n_members=packed.n_members,
+        parents=packed.parents,
+        creator=packed.creator,
+        seq=packed.seq,
+        t=packed.t,
+        coin=packed.coin,
+        stake=packed.stake,
+        fork_pairs=packed.fork_pairs,
+        member_table=packed.member_table,
+        ids=np.frombuffer(b"".join(packed.ids), dtype=np.uint8),
+        sigs=_pack_bytes_list(packed.sigs),
+    )
+
+
+def load_packed(path: str) -> PackedDAG:
+    z = np.load(path)
+    if int(z["format_version"]) != FORMAT_VERSION:
+        raise ValueError(f"unsupported checkpoint version {z['format_version']}")
+    ids_flat = z["ids"].tobytes()
+    h = crypto.HASH_BYTES
+    return PackedDAG(
+        n=int(z["n"]),
+        n_members=int(z["n_members"]),
+        parents=z["parents"],
+        creator=z["creator"],
+        seq=z["seq"],
+        t=z["t"],
+        coin=z["coin"],
+        stake=z["stake"],
+        fork_pairs=z["fork_pairs"],
+        member_table=z["member_table"],
+        ids=[ids_flat[i : i + h] for i in range(0, len(ids_flat), h)],
+        sigs=_unpack_bytes_list(z["sigs"]),
+    )
+
+
+def save_node(path: str, node: Node) -> None:
+    """Write the node's full event log (wire format) + config + members."""
+    log = b"".join(encode_event(node.hg[e]) for e in node.order_added)
+    cfg = dataclasses.asdict(node.config)
+    cfg["stake"] = list(node.config.stakes())
+    meta = {
+        "format_version": FORMAT_VERSION,
+        "config": cfg,
+        "members": [m.hex() for m in node.members],
+        "n_events": len(node.order_added),
+    }
+    header = json.dumps(meta).encode()
+    with open(path, "wb") as f:
+        f.write(b"SWCK" + struct.pack("<I", len(header)) + header + log)
+
+
+def load_node(
+    path: str,
+    sk: bytes,
+    pk: bytes,
+    network: Dict[bytes, Callable],
+    network_want: Optional[Dict[bytes, Callable]] = None,
+    clock: Optional[Callable[[], int]] = None,
+) -> Node:
+    """Rebuild a node from a checkpoint: replay the validated event log and
+    run one batch consensus pass (bit-identical by purity)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    if data[:4] != b"SWCK":
+        raise ValueError("not a tpu_swirld checkpoint")
+    (hlen,) = struct.unpack_from("<I", data, 4)
+    meta = json.loads(data[8 : 8 + hlen].decode())
+    if meta["format_version"] != FORMAT_VERSION:
+        raise ValueError(f"unsupported checkpoint version {meta['format_version']}")
+    cfg_dict = dict(meta["config"])
+    cfg_dict["stake"] = tuple(cfg_dict["stake"])
+    cfg = SwirldConfig(**cfg_dict)
+    members = [bytes.fromhex(m) for m in meta["members"]]
+    node = Node(
+        sk=sk, pk=pk, network=network, members=members, config=cfg,
+        clock=clock, create_genesis=False, network_want=network_want,
+    )
+    off = 8 + hlen
+    new_ids = []
+    while off < len(data):
+        ev, off = decode_event(data, off)
+        if node.add_event(ev):
+            new_ids.append(ev.id)
+    node.consensus_pass(new_ids)
+    return node

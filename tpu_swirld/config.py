@@ -42,6 +42,8 @@ class SwirldConfig:
     mesh_shape: Optional[Dict[str, int]] = None
     block_size: int = 256
     max_rounds: int = 256
+    max_orphans: int = 4096      # unknown-parent events parked per node
+    max_want_rounds: int = 32    # want-list round-trips per sync
 
     def stakes(self) -> Tuple[int, ...]:
         if self.stake is not None:

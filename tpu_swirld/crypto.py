@@ -120,12 +120,21 @@ def keypair(seed: bytes) -> Tuple[bytes, bytes]:
     return _BACKEND.keypair(seed)
 
 
-def sign(body: bytes, sk: bytes) -> bytes:
-    return _BACKEND.sign(body, sk)
+# Domain-separation tags: the same key signs event bodies, sync requests,
+# sync replies, and want-list requests — each message type gets its own
+# prefix so a signature can never be replayed across contexts.
+DOMAIN_EVENT = b"EVNT:"
+DOMAIN_SYNC_REQ = b"SYNQ:"
+DOMAIN_SYNC_REPLY = b"SYNR:"
+DOMAIN_WANT = b"WANT:"
 
 
-def verify(body: bytes, sig: bytes, pk: bytes) -> bool:
-    return _BACKEND.verify(body, sig, pk)
+def sign(body: bytes, sk: bytes, domain: bytes = b"") -> bytes:
+    return _BACKEND.sign(domain + body, sk)
+
+
+def verify(body: bytes, sig: bytes, pk: bytes, domain: bytes = b"") -> bool:
+    return _BACKEND.verify(domain + body, sig, pk)
 
 
 def coin_bit(sig: bytes) -> int:

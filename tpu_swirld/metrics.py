@@ -1,0 +1,77 @@
+"""Metrics / observability (SURVEY.md §5).
+
+Lightweight per-phase wall-clock counters plus the protocol-level gauges
+the driver metric is built from: events ingested, events ordered
+(events-to-consensus), decided-round lag, and undecided-witness backlog.
+Zero overhead when disabled (the default); enable per node with
+``node.metrics = Metrics()`` or pass ``metrics=`` to the engine helpers.
+
+``jax.profiler`` traces for the device pipeline are one call away:
+:func:`trace_consensus` wraps a pipeline run in a profiler trace directory
+viewable with TensorBoard/XProf.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Optional
+
+
+class Metrics:
+    """Cumulative phase timers + counters."""
+
+    def __init__(self) -> None:
+        self.seconds: Dict[str, float] = {}
+        self.counts: Dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.seconds[name] = self.seconds.get(name, 0.0) + (
+                time.perf_counter() - t0
+            )
+
+    def count(self, name: str, delta: int = 1) -> None:
+        self.counts[name] = self.counts.get(name, 0) + delta
+
+    def snapshot(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        out.update({f"s_{k}": round(v, 6) for k, v in self.seconds.items()})
+        out.update({f"n_{k}": v for k, v in self.counts.items()})
+        total = sum(
+            self.seconds.get(k, 0.0)
+            for k in ("divide_rounds", "decide_fame", "find_order")
+        )
+        ordered = self.counts.get("events_ordered", 0)
+        if total > 0 and ordered:
+            out["events_per_sec_to_consensus"] = round(ordered / total, 2)
+        return out
+
+
+def node_gauges(node) -> Dict[str, int]:
+    """Protocol-level gauges for one oracle node."""
+    undecided = sum(1 for f in node.famous.values() if f is None)
+    return {
+        "events": len(node.hg),
+        "events_ordered": len(node.consensus),
+        "max_round": node.max_round,
+        "decided_round_lag": node.max_round - node.consensus_round,
+        "undecided_witnesses": undecided,
+        "orphans_parked": len(node._orphans),
+        "ancient_quarantined": len(node.ancient),
+    }
+
+
+def trace_consensus(packed, config=None, outdir: str = "/tmp/swirld-trace", **kw):
+    """Run the device pipeline under a jax.profiler trace (XProf viewable)."""
+    import jax
+
+    from tpu_swirld.tpu.pipeline import run_consensus
+
+    with jax.profiler.trace(outdir):
+        result = run_consensus(packed, config, **kw)
+    return result

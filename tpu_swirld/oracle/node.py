@@ -83,6 +83,7 @@ class Node:
         config: Optional[SwirldConfig] = None,
         clock: Optional[Callable[[], int]] = None,
         create_genesis: bool = True,
+        network_want: Optional[Dict[bytes, Callable]] = None,
     ):
         self.config = config or SwirldConfig(n_members=len(members))
         if len(members) != self.config.n_members:
@@ -90,6 +91,9 @@ class Node:
         self.sk = sk
         self.pk = pk
         self.network = network
+        self.network_want = network_want if network_want is not None else {}
+        self._orphans: Dict[bytes, Event] = {}
+        self.metrics = None   # set to metrics.Metrics() to enable counters
         self.members: List[bytes] = list(members)
         self.member_index: Dict[bytes, int] = {m: i for i, m in enumerate(members)}
         stakes = self.config.stakes()
@@ -166,7 +170,16 @@ class Node:
 
     def is_valid_event(self, ev: Event) -> bool:
         """Structural + cryptographic validation (reference: hash/signature/
-        parent checks incl. fork-relevant creator constraints)."""
+        parent checks incl. fork-relevant creator constraints).
+
+        Enforces the same size caps as the wire decoder — an event a peer
+        could never decode must not enter the store (it would poison every
+        future sync reply containing it).
+        """
+        from tpu_swirld.oracle.event import MAX_KEY, MAX_PAYLOAD
+
+        if len(ev.d) > MAX_PAYLOAD or len(ev.c) > MAX_KEY:
+            return False
         if ev.c not in self.member_index:
             return False
         if not ev.verify():
@@ -321,11 +334,22 @@ class Node:
 
         The asker's height vector is signed; the reply (concatenated encoded
         events) is signed by us.  (Reference contract: SURVEY.md §2 #4.)
+
+        The count vector is only a *hint*: per-creator counts identify a
+        chain prefix only while that creator is honest.  For creators we
+        know to have forked, we send ALL their events (forks are rare and
+        bounded by the adversary's budget); remaining gaps — e.g. forks we
+        have not detected ourselves — surface on the asker's side as
+        orphans, which it recovers via :meth:`ask_events`.
         """
+        if from_pk not in self.member_index:
+            raise ValueError("unknown sync peer")
         payload = signed_heights[: -crypto.SIG_BYTES]
         sig = signed_heights[-crypto.SIG_BYTES:]
-        if not crypto.verify(payload, sig, from_pk):
+        if not crypto.verify(payload, sig, from_pk, crypto.DOMAIN_SYNC_REQ):
             raise ValueError("bad sync-request signature")
+        if len(payload) != 4 * len(self.members):
+            raise ValueError("malformed sync-request height vector")
         heights: Dict[bytes, int] = {}
         off = 0
         for m in self.members:
@@ -333,32 +357,129 @@ class Node:
             off += 4
         missing: List[bytes] = []
         for m in self.members:
-            missing.extend(self.member_events[m][heights[m]:])
-        missing = toposort(
-            sorted(missing, key=lambda e: self.idx[e]),
+            if self.has_fork[m]:
+                missing.extend(self.member_events[m])
+            else:
+                missing.extend(self.member_events[m][heights[m]:])
+        return self._sign_event_blob(missing)
+
+    def _sign_event_blob(self, ids: List[bytes]) -> bytes:
+        ordered = toposort(
+            sorted(ids, key=lambda e: self.idx[e]),
             lambda e: [p for p in self.hg[e].p],
         )
-        blob = b"".join(encode_event(self.hg[e]) for e in missing)
-        return blob + crypto.sign(blob, self.sk)
+        blob = b"".join(encode_event(self.hg[e]) for e in ordered)
+        return blob + crypto.sign(blob, self.sk, crypto.DOMAIN_SYNC_REPLY)
+
+    def ask_events(self, from_pk: bytes, signed_want: bytes) -> bytes:
+        """Serve a want-list: the asker requests specific event ids (orphan
+        parents it is missing); reply with those we have, topo-sorted and
+        signed.  Unknown ids are silently skipped."""
+        if from_pk not in self.member_index:
+            raise ValueError("unknown sync peer")
+        payload = signed_want[: -crypto.SIG_BYTES]
+        sig = signed_want[-crypto.SIG_BYTES:]
+        if not crypto.verify(payload, sig, from_pk, crypto.DOMAIN_WANT):
+            raise ValueError("bad want-list signature")
+        if len(payload) % crypto.HASH_BYTES:
+            raise ValueError("malformed want-list")
+        want = [
+            payload[i : i + crypto.HASH_BYTES]
+            for i in range(0, len(payload), crypto.HASH_BYTES)
+        ]
+        have = [h for h in want if h in self.hg]
+        return self._sign_event_blob(have)
+
+    def _decode_signed_blob(self, reply: bytes, peer_pk: bytes) -> List[Event]:
+        if len(reply) < crypto.SIG_BYTES:
+            raise ValueError("short sync reply")
+        blob = reply[: -crypto.SIG_BYTES]
+        sig = reply[-crypto.SIG_BYTES:]
+        if not crypto.verify(blob, sig, peer_pk, crypto.DOMAIN_SYNC_REPLY):
+            raise ValueError("bad sync-reply signature")
+        events: List[Event] = []
+        off = 0
+        while off < len(blob):
+            ev, off = decode_event(blob, off)   # raises MalformedEvent
+            events.append(ev)
+        return events
+
+    def _ingest(self, events: Iterable[Event], new_ids: List[bytes]) -> None:
+        """Insert events whose parents are known; park the rest as orphans,
+        then drain the orphan buffer to a fixpoint."""
+        for ev in events:
+            eid = ev.id
+            if eid in self.hg:
+                continue
+            if ev.p and any(p not in self.hg for p in ev.p):
+                if len(self._orphans) < self.config.max_orphans:
+                    self._orphans[eid] = ev
+                continue
+            try:
+                if self.add_event(ev):
+                    new_ids.append(eid)
+            except ValueError:
+                pass   # invalid event in a signed reply: drop, don't crash
+        # fixpoint drain: an inserted orphan may unblock other orphans
+        progress = True
+        while progress and self._orphans:
+            progress = False
+            for eid, ev in list(self._orphans.items()):
+                if not ev.p or all(p in self.hg for p in ev.p):
+                    del self._orphans[eid]
+                    try:
+                        if self.add_event(ev):
+                            new_ids.append(eid)
+                            progress = True
+                    except ValueError:
+                        pass   # invalid orphan: drop it
+
+    def _missing_parents(self) -> List[bytes]:
+        return sorted(
+            {
+                p
+                for ev in self._orphans.values()
+                for p in ev.p
+                if p not in self.hg and p not in self._orphans
+            }
+        )
+
+    def pull(self, peer_pk: bytes) -> List[bytes]:
+        """Receive the peer's delta (no own-event creation).
+
+        Events with unknown parents never crash the node: they are parked
+        in an orphan buffer and their missing ancestors are requested from
+        the same peer by hash (want-list), iterating to closure.  Anything
+        the peer cannot supply stays parked for later syncs.
+        """
+        hv = b"".join(
+            len(self.member_events[m]).to_bytes(4, "little") for m in self.members
+        )
+        req = hv + crypto.sign(hv, self.sk, crypto.DOMAIN_SYNC_REQ)
+        reply = self.network[peer_pk](self.pk, req)
+        new_ids: List[bytes] = []
+        self._ingest(self._decode_signed_blob(reply, peer_pk), new_ids)
+        # want-list recovery: bounded by DAG depth, capped defensively
+        ask = self.network_want.get(peer_pk)
+        for _ in range(self.config.max_want_rounds):
+            want = self._missing_parents()
+            if not want or ask is None:
+                break
+            wv = b"".join(want)
+            wreq = wv + crypto.sign(wv, self.sk, crypto.DOMAIN_WANT)
+            got = self._decode_signed_blob(ask(self.pk, wreq), peer_pk)
+            if not got:
+                break
+            before = len(new_ids) + len(self._orphans)
+            self._ingest(got, new_ids)
+            if len(new_ids) + len(self._orphans) == before:
+                break   # no progress: stop asking this peer
+        return new_ids
 
     def sync(self, peer_pk: bytes, payload: bytes) -> List[bytes]:
         """Gossip with ``peer_pk``; returns new event ids in topo order
         (received sub-DAG first, then our freshly created event)."""
-        hv = b"".join(
-            len(self.member_events[m]).to_bytes(4, "little") for m in self.members
-        )
-        req = hv + crypto.sign(hv, self.sk)
-        reply = self.network[peer_pk](self.pk, req)
-        blob = reply[: -crypto.SIG_BYTES]
-        sig = reply[-crypto.SIG_BYTES:]
-        if not crypto.verify(blob, sig, peer_pk):
-            raise ValueError("bad sync-reply signature")
-        new_ids: List[bytes] = []
-        off = 0
-        while off < len(blob):
-            ev, off = decode_event(blob, off)
-            if self.add_event(ev):
-                new_ids.append(ev.id)
+        new_ids = self.pull(peer_pk)
         peer_events = self.member_events[peer_pk]
         if not peer_events:
             return new_ids
@@ -564,9 +685,20 @@ class Node:
 
     def consensus_pass(self, new_ids: List[bytes]) -> None:
         """The three consensus calls in reference order (the pluggable seam)."""
-        self.divide_rounds(new_ids)
-        self.decide_fame()
-        self.find_order()
+        if self.metrics is None:
+            self.divide_rounds(new_ids)
+            self.decide_fame()
+            self.find_order()
+            return
+        before = len(self.consensus)
+        with self.metrics.phase("divide_rounds"):
+            self.divide_rounds(new_ids)
+        with self.metrics.phase("decide_fame"):
+            self.decide_fame()
+        with self.metrics.phase("find_order"):
+            self.find_order()
+        self.metrics.count("events_processed", len(new_ids))
+        self.metrics.count("events_ordered", len(self.consensus) - before)
 
     def main(self, pick_peer: Callable[[], bytes], payload_fn=None):
         """Coroutine: each resume gossips with one random peer and runs a

@@ -78,6 +78,7 @@ def make_simulation(
     keys = [crypto.keypair(b"member-%d-%d" % (seed, i)) for i in range(n_nodes)]
     members = [pk for pk, _ in keys]
     network: Dict[bytes, Callable] = {}
+    network_want: Dict[bytes, Callable] = {}
     clock = [0]
     nodes: List[Node] = []
     for pk, sk in keys:
@@ -88,8 +89,10 @@ def make_simulation(
             members=members,
             config=config,
             clock=lambda: clock[0],
+            network_want=network_want,
         )
         network[pk] = node.ask_sync
+        network_want[pk] = node.ask_events
         nodes.append(node)
     sim = Simulation(config=config, nodes=nodes, network=network, rng=rng, clock=clock)
     # shared logical clock advances every turn so timestamps vary
@@ -111,14 +114,15 @@ def test(n_nodes: int, n_turns: int, seed: int = 0) -> Simulation:
 
 
 class ForkingAdversary:
-    """Byzantine members that fork: they occasionally create TWO events with
-    the same self-parent and gossip different branches to different peers
-    (BASELINE.json config 4: f forkers out of n).
+    """*Consistent-order* fork injection: a forker occasionally creates a
+    sibling of its own head (same self-parent) in its own store, whence
+    both branches propagate to every peer through the same honest
+    ``ask_sync`` path in one arrival order.
 
-    The adversary drives a forker's key directly (it doesn't use the honest
-    ``Node.sync`` path for its own event creation), injecting its forked
-    events into honest nodes via their public ``ask_sync``-fed event feed —
-    here simulated by direct insertion through a crafted sync reply.
+    This exercises fork *detection and tolerance* on a DAG every node sees
+    identically.  It does NOT create divergent per-peer views — for the
+    byzantine equivocation case (different branches served to different
+    peers) use :class:`DivergentForker` / :func:`run_with_divergent_forkers`.
     """
 
     def __init__(self, sim: Simulation, forker_indices: List[int], fork_every: int = 5):
@@ -172,11 +176,156 @@ def run_with_forkers(
     return sim
 
 
+class DivergentForker:
+    """A genuinely equivocating byzantine member: it maintains TWO branch
+    views of its own chain and serves *different branches to different
+    peers* through its public ``ask_sync`` / ``ask_events`` endpoints
+    (BASELINE config 4's adversary model).
+
+    Each branch is a full honest :class:`Node` sharing the forker's key;
+    peers are pinned to a branch on first contact.  ``step()`` advances
+    both branches: each pulls from a random honest peer (receiving real
+    gossip) and extends its own self-chain — producing fork pairs at every
+    sequence number.  Honest nodes first receive one branch, later learn
+    of the other through third parties (orphan + want-list recovery), and
+    must detect the fork and converge without crashing.
+    """
+
+    def __init__(
+        self,
+        sk: bytes,
+        pk: bytes,
+        members: List[bytes],
+        network: Dict[bytes, Callable],
+        network_want: Dict[bytes, Callable],
+        config: SwirldConfig,
+        clock: Callable[[], int],
+        rng: random.Random,
+    ):
+        self.pk = pk
+        self.sk = sk
+        self.rng = rng
+        self.branches = [
+            Node(
+                sk=sk, pk=pk, network=network, members=members,
+                config=config, clock=clock, network_want=network_want,
+            )
+            for _ in range(2)
+        ]
+        # both branches created the identical deterministic genesis; track
+        # per-branch heads explicitly (ingesting the sibling branch back
+        # from honest gossip must not move a branch's own tip)
+        self._heads = [br.head for br in self.branches]
+        self._route: Dict[bytes, int] = {}
+
+    def _branch_for(self, peer_pk: bytes) -> Node:
+        b = self._route.get(peer_pk)
+        if b is None:
+            b = len(self._route) % 2
+            self._route[peer_pk] = b
+        return self.branches[b]
+
+    def ask_sync(self, from_pk: bytes, req: bytes) -> bytes:
+        return self._branch_for(from_pk).ask_sync(from_pk, req)
+
+    def ask_events(self, from_pk: bytes, req: bytes) -> bytes:
+        return self._branch_for(from_pk).ask_events(from_pk, req)
+
+    def step(self, honest_peers: List[bytes]) -> None:
+        """Advance both branches: pull real gossip, extend the fork."""
+        for bi, br in enumerate(self.branches):
+            peer = honest_peers[self.rng.randrange(len(honest_peers))]
+            try:
+                br.pull(peer)
+            except ValueError:
+                pass
+            op = br.member_events[peer][-1] if br.member_events[peer] else None
+            if op is None:
+                continue
+            ev = Event(
+                d=b"branch:%d:%d" % (bi, len(br.hg)),
+                p=(self._heads[bi], op),
+                t=br._now(),
+                c=self.pk,
+            ).signed(self.sk)
+            br.add_event(ev)
+            self._heads[bi] = ev.id
+
+
+@dataclasses.dataclass
+class DivergentSimulation:
+    """Honest nodes + equivocating forkers sharing one gossip network."""
+
+    config: SwirldConfig
+    nodes: List[Node]                  # honest nodes only
+    forkers: List[DivergentForker]
+    network: Dict[bytes, Callable]
+    rng: random.Random
+    clock: List[int]
+    members: List[bytes]
+
+
+def run_with_divergent_forkers(
+    n_nodes: int,
+    n_forkers: int,
+    n_turns: int,
+    seed: int = 0,
+    fork_every: int = 3,
+) -> DivergentSimulation:
+    """Config-4 adversary model: ``n_forkers`` equivocating members serving
+    divergent branches; honest nodes must stay live and prefix-consistent
+    (within the BFT bound ``n > 3f``)."""
+    config = SwirldConfig(n_members=n_nodes, seed=seed)
+    rng = random.Random(seed)
+    keys = [crypto.keypair(b"member-%d-%d" % (seed, i)) for i in range(n_nodes)]
+    members = [pk for pk, _ in keys]
+    network: Dict[bytes, Callable] = {}
+    network_want: Dict[bytes, Callable] = {}
+    clock = [0]
+    forkers: List[DivergentForker] = []
+    honest: List[Node] = []
+    for i, (pk, sk) in enumerate(keys):
+        if i < n_forkers:
+            f = DivergentForker(
+                sk, pk, members, network, network_want, config,
+                lambda: clock[0], rng,
+            )
+            network[pk] = f.ask_sync
+            network_want[pk] = f.ask_events
+            forkers.append(f)
+        else:
+            node = Node(
+                sk=sk, pk=pk, network=network, members=members,
+                config=config, clock=lambda: clock[0],
+                network_want=network_want,
+            )
+            network[pk] = node.ask_sync
+            network_want[pk] = node.ask_events
+            honest.append(node)
+    honest_pks = [n.pk for n in honest]
+    for turn in range(n_turns):
+        clock[0] += 1
+        node = honest[rng.randrange(len(honest))]
+        peers = [pk for pk in members if pk != node.pk]
+        peer = peers[rng.randrange(len(peers))]
+        new_ids = node.sync(peer, b"tx:%d" % turn)
+        node.consensus_pass(new_ids)
+        if turn % fork_every == 0:
+            for f in forkers:
+                f.step(honest_pks)
+    return DivergentSimulation(
+        config=config, nodes=honest, forkers=forkers, network=network,
+        rng=rng, clock=clock, members=members,
+    )
+
+
 def generate_gossip_dag(
     n_members: int,
     n_events: int,
     seed: int = 0,
     stake: Optional[List[int]] = None,
+    n_forkers: int = 0,
+    fork_prob: float = 0.05,
 ):
     """Directly synthesize a valid random-gossip DAG (no per-node stores).
 
@@ -184,6 +333,12 @@ def generate_gossip_dag(
     self-chains stitched by random cross-member other-parents — but in
     O(n_events) work, so BASELINE configs 3+ (64 members / 10k events) can
     be generated in seconds.  Used by ``bench.py`` and the graft entry.
+
+    With ``n_forkers`` the first f members equivocate: with probability
+    ``fork_prob`` a forker's new event is a *sibling* of its current head
+    (same self-parent — a fork pair), and its chain thereafter extends a
+    randomly chosen branch, producing realistic fork trees for BASELINE
+    config 4 (64 members, f=21, fork-detection parity).
 
     Returns ``(members, stake, events, keys)`` with ``events`` in topo
     order and ``keys`` the (pk, sk) pairs (so callers can build observer or
@@ -194,26 +349,41 @@ def generate_gossip_dag(
     members = [pk for pk, _ in keys]
     stake = list(stake) if stake is not None else [1] * n_members
     events: List[Event] = []
-    heads: List[Event] = []
+    branches: List[List[Event]] = []     # per member: branch heads
     t = 0
     for pk, sk in keys:
         t += 1
         ev = Event(d=b"", p=(), t=t, c=pk).signed(sk)
         events.append(ev)
-        heads.append(ev)
+        branches.append([ev])
     while len(events) < n_events:
         ci = rng.randrange(n_members)
         pi = rng.randrange(n_members - 1)
         if pi >= ci:
             pi += 1
         pk, sk = keys[ci]
+        other = branches[pi][rng.randrange(len(branches[pi]))]
+        bi = rng.randrange(len(branches[ci]))
+        head = branches[ci][bi]
         t += 1
-        ev = Event(
-            d=b"tx:%d" % len(events),
-            p=(heads[ci].id, heads[pi].id),
-            t=t,
-            c=pk,
-        ).signed(sk)
-        events.append(ev)
-        heads[ci] = ev
+        fork_now = (
+            ci < n_forkers and head.p and rng.random() < fork_prob
+        )
+        if fork_now:
+            # sibling of the current head: same self-parent, new branch
+            sp = head.p[0]
+            ev = Event(
+                d=b"fork:%d" % len(events), p=(sp, other.id), t=t, c=pk
+            ).signed(sk)
+            events.append(ev)
+            branches[ci].append(ev)
+        else:
+            ev = Event(
+                d=b"tx:%d" % len(events),
+                p=(head.id, other.id),
+                t=t,
+                c=pk,
+            ).signed(sk)
+            events.append(ev)
+            branches[ci][bi] = ev
     return members, stake, events, keys

@@ -50,6 +50,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import math
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -68,6 +69,11 @@ INT32_MAX = np.iinfo(np.int32).max
 
 def default_matmul_dtype():
     return jnp.float32 if jax.default_backend() == "cpu" else jnp.bfloat16
+
+
+def _bucket(v: int, m: int) -> int:
+    """Round up to a multiple of m (recompile hygiene for static shapes)."""
+    return ((max(v, 1) + m - 1) // m) * m
 
 
 def _bmm(a: jnp.ndarray, b: jnp.ndarray, dtype) -> jnp.ndarray:
@@ -448,6 +454,61 @@ def order_scan(
 # ----------------------------------------------------------- fused kernel
 
 
+def rounds_body(
+    parents, creator, stake, fork_pairs, member_table, n_valid, *,
+    tot_stake, block, r_max, s_max, has_forks, matmul_dtype_name,
+    ssm_fn=None,
+):
+    """Stage A: ancestry -> sees -> strongly-sees -> rounds/witness scan.
+
+    ``ssm_fn`` overrides the strongly-sees kernel (the FLOP bottleneck) —
+    ``tpu_swirld.parallel`` passes the mesh-sharded version.  Jittable.
+    """
+    dt = jnp.bfloat16 if matmul_dtype_name == "bfloat16" else jnp.float32
+    n_members = stake.shape[0]
+    anc = ancestry(parents, block=block, matmul_dtype=dt)
+    fseen = forkseen_matrix(anc, fork_pairs, n_members, dt)
+    sees = sees_matrix(anc, fseen, creator)
+    if ssm_fn is None:
+        ssm = ssm_matrix(sees, member_table, stake, tot_stake, dt)
+    else:
+        ssm = ssm_fn(sees, member_table, stake, tot_stake, dt)
+    rnd, wits, tab, cnt, overflow = rounds_scan(
+        parents, ssm, creator, stake, tot_stake, n_valid,
+        r_max=r_max, s_max=s_max, has_forks=has_forks,
+    )
+    max_round = jnp.max(jnp.where(jnp.arange(rnd.shape[0]) < n_valid, rnd, 0))
+    return {
+        "anc": anc, "sees": sees, "ssm": ssm, "round": rnd,
+        "is_witness": wits, "wit_table": tab, "wit_count": cnt,
+        "overflow": overflow, "max_round": max_round,
+    }
+
+
+def fame_order_body(
+    anc, sees, ssm, wit_table, wit_count, creator, coin, stake, self_parent,
+    t_rank, max_round, n_valid, *,
+    tot_stake, coin_period, r_max, s_max, chain, has_forks,
+    matmul_dtype_name,
+):
+    """Stage B: fame fixed point + order extraction over rounds [0, r_max)."""
+    dt = jnp.bfloat16 if matmul_dtype_name == "bfloat16" else jnp.float32
+    tab = wit_table[:r_max]
+    cnt = wit_count[:r_max]
+    famous = fame_scan(
+        tab, sees, ssm, creator, coin, stake, tot_stake, coin_period, dt,
+        has_forks=has_forks,
+    )
+    rr, cts_rank = order_scan(
+        anc, tab, cnt, famous, creator, self_parent, t_rank, max_round,
+        n_valid, chain=chain,
+    )
+    return {
+        "famous": famous, "round_received": rr,
+        "consensus_ts_rank": cts_rank,
+    }
+
+
 def consensus_body(
     parents,
     creator,
@@ -470,64 +531,32 @@ def consensus_body(
 ):
     """End-to-end device consensus: packed arrays -> all consensus outputs.
 
-    ``ssm_fn`` overrides the strongly-sees kernel (the FLOP bottleneck) —
-    ``tpu_swirld.parallel`` passes the mesh-sharded version.  Jittable.
+    Composes :func:`rounds_body` + :func:`fame_order_body` in one trace —
+    the fused single-jit form used by the graft entry and the mesh path.
+    ``run_consensus`` instead runs the two stages as separate jits so the
+    second can be re-bound with a tight ``r_max``.
     """
-    dt = jnp.bfloat16 if matmul_dtype_name == "bfloat16" else jnp.float32
-    n_members = stake.shape[0]
-    anc = ancestry(parents, block=block, matmul_dtype=dt)
-    fseen = forkseen_matrix(anc, fork_pairs, n_members, dt)
-    sees = sees_matrix(anc, fseen, creator)
-    if ssm_fn is None:
-        ssm = ssm_matrix(sees, member_table, stake, tot_stake, dt)
-    else:
-        ssm = ssm_fn(sees, member_table, stake, tot_stake, dt)
-    rnd, wits, tab, cnt, overflow = rounds_scan(
-        parents,
-        ssm,
-        creator,
-        stake,
-        tot_stake,
-        n_valid,
-        r_max=r_max,
-        s_max=s_max,
-        has_forks=has_forks,
+    a = rounds_body(
+        parents, creator, stake, fork_pairs, member_table, n_valid,
+        tot_stake=tot_stake, block=block, r_max=r_max, s_max=s_max,
+        has_forks=has_forks, matmul_dtype_name=matmul_dtype_name,
+        ssm_fn=ssm_fn,
     )
-    famous = fame_scan(
-        tab,
-        sees,
-        ssm,
-        creator,
-        coin,
-        stake,
-        tot_stake,
-        coin_period,
-        dt,
-        has_forks=has_forks,
-    )
-    max_round = jnp.max(jnp.where(jnp.arange(rnd.shape[0]) < n_valid, rnd, 0))
-    rr, cts_rank = order_scan(
-        anc,
-        tab,
-        cnt,
-        famous,
-        creator,
-        parents[:, 0],
-        t_rank,
-        max_round,
-        n_valid,
-        chain=chain,
+    b = fame_order_body(
+        a["anc"], a["sees"], a["ssm"], a["wit_table"], a["wit_count"],
+        creator, coin, stake, parents[:, 0], t_rank, a["max_round"], n_valid,
+        tot_stake=tot_stake, coin_period=coin_period, r_max=r_max,
+        s_max=s_max, chain=chain, has_forks=has_forks,
+        matmul_dtype_name=matmul_dtype_name,
     )
     return {
-        "round": rnd,
-        "is_witness": wits,
-        "wit_table": tab,
-        "wit_count": cnt,
-        "famous": famous,
-        "round_received": rr,
-        "consensus_ts_rank": cts_rank,
-        "overflow": overflow,
-        "max_round": max_round,
+        "round": a["round"],
+        "is_witness": a["is_witness"],
+        "wit_table": a["wit_table"],
+        "wit_count": a["wit_count"],
+        "overflow": a["overflow"],
+        "max_round": a["max_round"],
+        **b,
     }
 
 
@@ -545,6 +574,22 @@ consensus_arrays = functools.partial(
     ),
 )(consensus_body)
 
+rounds_stage = functools.partial(
+    jax.jit,
+    static_argnames=(
+        "tot_stake", "block", "r_max", "s_max", "has_forks",
+        "matmul_dtype_name",
+    ),
+)(rounds_body)
+
+fame_order_stage = functools.partial(
+    jax.jit,
+    static_argnames=(
+        "tot_stake", "coin_period", "r_max", "s_max", "chain", "has_forks",
+        "matmul_dtype_name",
+    ),
+)(fame_order_body)
+
 
 # ------------------------------------------------------- host orchestration
 
@@ -561,6 +606,7 @@ class ConsensusResult:
     consensus_ts: np.ndarray     # int64[n]
     order: List[int]             # final total order (packed indices)
     max_round: int
+    timings: Dict[str, float] = dataclasses.field(default_factory=dict)
 
 
 def _pad_packed(packed: PackedDAG, block: int):
@@ -582,6 +628,66 @@ def _pad_packed(packed: PackedDAG, block: int):
     return n_pad, parents, creator, seq, t, coin
 
 
+def prepare_inputs(
+    packed: PackedDAG,
+    config: Optional[SwirldConfig] = None,
+    *,
+    block: int = 128,
+    r_max: Optional[int] = None,
+    s_max: Optional[int] = None,
+    matmul_dtype_name: Optional[str] = None,
+):
+    """Host prep shared by :func:`run_consensus` and the graft entry:
+    block padding, dense timestamp ranks, and the static shape parameters.
+
+    Returns ``(arrays, statics, ts_unique)`` where ``arrays`` holds the
+    numpy kernel inputs (keys match the kernel's positional order:
+    parents, creator, t_rank, coin, stake, fork_pairs, member_table,
+    n_valid) and ``statics`` the keyword shape parameters.
+    """
+    config = config or SwirldConfig(n_members=packed.n_members)
+    if matmul_dtype_name is None:
+        matmul_dtype_name = (
+            "float32" if jax.default_backend() == "cpu" else "bfloat16"
+        )
+    n = packed.n
+    _n_pad, parents, creator, _seq, t, coin = _pad_packed(packed, block)
+    extras = (
+        len(set(packed.fork_pairs[:, 2].tolist()))
+        if len(packed.fork_pairs)
+        else 0
+    )
+    if s_max is None:
+        s_max = packed.n_members + extras + 1
+    if r_max is None:
+        r_max = int(config.max_rounds)
+    chain = int(packed.seq.max()) + 1 if n else 1
+    # dense-rank timestamps so the device stays int32-pure (see module doc)
+    ts_unique, t_rank = np.unique(t, return_inverse=True)
+    t_rank = t_rank.astype(np.int32).reshape(t.shape)
+    arrays = {
+        "parents": parents,
+        "creator": creator,
+        "t_rank": t_rank,
+        "coin": coin,
+        "stake": packed.stake,
+        "fork_pairs": packed.fork_pairs,
+        "member_table": packed.member_table,
+        "n_valid": np.int32(n),
+    }
+    statics = {
+        "tot_stake": int(packed.stake.sum()),
+        "coin_period": config.coin_period,
+        "block": block,
+        "r_max": r_max,
+        "s_max": s_max,
+        "chain": chain,
+        "has_forks": bool(len(packed.fork_pairs)),
+        "matmul_dtype_name": matmul_dtype_name,
+    }
+    return arrays, statics, ts_unique
+
+
 def run_consensus(
     packed: PackedDAG,
     config: Optional[SwirldConfig] = None,
@@ -601,59 +707,124 @@ def run_consensus(
     sharded over the mesh with psum stake aggregation
     (:mod:`tpu_swirld.parallel`).
     """
+    arrays, statics, ts_unique = prepare_inputs(
+        packed, config, block=block, r_max=r_max, s_max=s_max,
+        matmul_dtype_name=matmul_dtype_name,
+    )
     config = config or SwirldConfig(n_members=packed.n_members)
-    if matmul_dtype_name is None:
-        matmul_dtype_name = (
-            "float32" if jax.default_backend() == "cpu" else "bfloat16"
-        )
     n = packed.n
-    n_pad, parents, creator, seq, t, coin = _pad_packed(packed, block)
-    extras = len(set(packed.fork_pairs[:, 2].tolist())) if len(packed.fork_pairs) else 0
-    if s_max is None:
-        s_max = packed.n_members + extras + 1
-    if r_max is None:
-        r_max = int(config.max_rounds)
-    chain = int(packed.seq.max()) + 1 if n else 1
-    tot = int(packed.stake.sum())
-    # dense-rank timestamps so the device stays int32-pure (see module doc)
-    ts_unique, t_rank = np.unique(t, return_inverse=True)
-    t_rank = t_rank.astype(np.int32).reshape(t.shape)
-
-    member_table, stake = packed.member_table, packed.stake
-    if mesh is None:
-        kernel = consensus_arrays
-    else:
+    parents, creator, t_rank, coin = (
+        arrays["parents"], arrays["creator"], arrays["t_rank"], arrays["coin"]
+    )
+    member_table, stake = arrays["member_table"], arrays["stake"]
+    r_max, s_max = statics["r_max"], statics["s_max"]
+    chain = statics["chain"]
+    tot = statics["tot_stake"]
+    matmul_dtype_name = statics["matmul_dtype_name"]
+    if mesh is not None:
         from tpu_swirld.parallel import consensus_fn_for_mesh, pad_members
 
         member_table, stake = pad_members(
             member_table, stake, mesh.devices.size
         )
         kernel = consensus_fn_for_mesh(mesh)
+        out = kernel(
+            jnp.asarray(parents),
+            jnp.asarray(creator),
+            jnp.asarray(t_rank),
+            jnp.asarray(coin),
+            jnp.asarray(stake),
+            jnp.asarray(packed.fork_pairs),
+            jnp.asarray(member_table),
+            jnp.asarray(n, dtype=jnp.int32),
+            tot_stake=tot,
+            coin_period=config.coin_period,
+            block=block,
+            r_max=r_max,
+            s_max=s_max,
+            chain=chain,
+            has_forks=bool(len(packed.fork_pairs)),
+            matmul_dtype_name=matmul_dtype_name,
+        )
+        t_dev0 = time.perf_counter()
+        out = jax.tree.map(np.asarray, out)   # blocks on device completion
+        t_device = time.perf_counter() - t_dev0
+        if bool(out["overflow"]):
+            raise RuntimeError(
+                "witness table overflow: raise config.max_rounds / s_max"
+            )
+        t_fin0 = time.perf_counter()
+        result = finalize_order(packed, out, ts_unique)
+        result.timings = {
+            "device_and_dispatch": round(t_device, 6),
+            "finalize_host": round(time.perf_counter() - t_fin0, 6),
+        }
+        return result
 
-    out = kernel(
+    # single-host path: two stages with a tight fame/order r_max.
+    # max_round never exceeds the longest self-chain (a member's round
+    # rises at most once per own event), so the witness table is bounded
+    # by chain+1 rounds; bucket to limit recompiles.
+    r_rounds = min(r_max, _bucket(chain + 1, 32))
+    t_dev0 = time.perf_counter()
+    stage_a = rounds_stage(
         jnp.asarray(parents),
         jnp.asarray(creator),
-        jnp.asarray(t_rank),
-        jnp.asarray(coin),
         jnp.asarray(stake),
         jnp.asarray(packed.fork_pairs),
         jnp.asarray(member_table),
         jnp.asarray(n, dtype=jnp.int32),
         tot_stake=tot,
-        coin_period=config.coin_period,
         block=block,
-        r_max=r_max,
+        r_max=r_rounds,
+        s_max=s_max,
+        has_forks=bool(len(packed.fork_pairs)),
+        matmul_dtype_name=matmul_dtype_name,
+    )
+    if bool(stage_a["overflow"]):
+        raise RuntimeError(
+            "witness table overflow: raise config.max_rounds / s_max"
+        )
+    max_round = int(stage_a["max_round"])     # device -> host scalar
+    r_tight = min(r_rounds, _bucket(max_round + 3, 8))
+    stage_b = fame_order_stage(
+        stage_a["anc"],
+        stage_a["sees"],
+        stage_a["ssm"],
+        stage_a["wit_table"],
+        stage_a["wit_count"],
+        jnp.asarray(creator),
+        jnp.asarray(coin),
+        jnp.asarray(stake),
+        jnp.asarray(parents[:, 0]),
+        jnp.asarray(t_rank),
+        stage_a["max_round"],
+        jnp.asarray(n, dtype=jnp.int32),
+        tot_stake=tot,
+        coin_period=config.coin_period,
+        r_max=r_tight,
         s_max=s_max,
         chain=chain,
         has_forks=bool(len(packed.fork_pairs)),
         matmul_dtype_name=matmul_dtype_name,
     )
-    out = jax.tree.map(np.asarray, out)
-    if bool(out["overflow"]):
-        raise RuntimeError(
-            "witness table overflow: raise config.max_rounds / s_max"
-        )
-    return finalize_order(packed, out, ts_unique)
+    out = {
+        "round": stage_a["round"],
+        "is_witness": stage_a["is_witness"],
+        "wit_table": stage_a["wit_table"][:r_tight],
+        "wit_count": stage_a["wit_count"][:r_tight],
+        "max_round": stage_a["max_round"],
+        **stage_b,
+    }
+    out = jax.tree.map(np.asarray, out)       # blocks on device completion
+    t_device = time.perf_counter() - t_dev0
+    t_fin0 = time.perf_counter()
+    result = finalize_order(packed, out, ts_unique)
+    result.timings = {
+        "device_and_dispatch": round(t_device, 6),
+        "finalize_host": round(time.perf_counter() - t_fin0, 6),
+    }
+    return result
 
 
 def finalize_order(
