@@ -272,3 +272,50 @@ def test_invalid_event_in_signed_reply_dropped_not_crash():
     from tpu_swirld.oracle.event import MAX_PAYLOAD
     big = Event(d=b"x" * (MAX_PAYLOAD + 1), p=(), t=8, c=pkA).signed(skA)
     assert not node.is_valid_event(big)
+
+
+def test_malformed_signed_reply_tolerated_in_pull():
+    """A byzantine peer returning garbage with a VALID reply signature must
+    not kill the honest gossip loop — pull() counts it and moves on."""
+    from tpu_swirld import crypto
+    from tpu_swirld.sim import make_simulation
+
+    sim = make_simulation(4, seed=6)
+    sim.run(30)
+    honest = sim.nodes[0]
+    evil = sim.nodes[1]
+
+    def evil_ask_sync(from_pk, req):
+        junk = b"\xff" * 37
+        return junk + crypto.sign(junk, evil.sk, crypto.DOMAIN_SYNC_REPLY)
+
+    sim.network[evil.pk] = evil_ask_sync
+    got = honest.pull(evil.pk)
+    assert got == [] and honest.bad_replies == 1
+    # and an unsigned-garbage reply too
+    sim.network[evil.pk] = lambda from_pk, req: b"\x00" * 10
+    assert honest.pull(evil.pk) == [] and honest.bad_replies == 2
+
+
+def test_orphan_buffer_resists_poisoning():
+    """Junk orphans (bad signature) are refused; overflow evicts FIFO
+    instead of permanently refusing new orphans."""
+    import dataclasses as dc
+
+    keys, members, node = _manual_population()
+    pkA, skA = keys[0]
+    fake_parent = b"\x99" * 32
+    # unsigned junk with unknown parents: must not be parked
+    junk = Event(d=b"j", p=(fake_parent, fake_parent), t=9, c=pkA, s=b"\x00" * 64)
+    node._ingest([junk], [])
+    assert not node._orphans
+    # validly-signed orphans beyond the cap evict oldest, not newest
+    node.config = dc.replace(node.config, max_orphans=2)
+    evs = [
+        Event(d=b"o%d" % i, p=(fake_parent, fake_parent), t=20 + i, c=pkA).signed(skA)
+        for i in range(3)
+    ]
+    node._ingest(evs, [])
+    assert len(node._orphans) == 2
+    assert evs[0].id not in node._orphans
+    assert evs[2].id in node._orphans

@@ -6,26 +6,38 @@ pure-Python hashgraph prototype (upstream layout: ``swirld.py`` /
 so SURVEY.md + BASELINE.json pin the spec), redesigned TPU-first:
 
 - ``tpu_swirld.oracle`` — the pure-Python reference ``Node`` (events,
-  validation, signed gossip sync, ``divide_rounds`` / ``decide_fame`` /
-  ``find_order``).  It is the bit-exactness oracle for the device path.
+  validation, signed gossip sync with orphan/want-list recovery,
+  ``divide_rounds`` / ``decide_fame`` / ``find_order``).  It is the
+  bit-exactness oracle for the device path.
 - ``tpu_swirld.packing`` — dense append-only packer: hash-DAG -> index
-  arrays (``parents: int32[N,2]``, creator, seq, timestamps, coin bits).
+  arrays (``parents: int32[N,2]``, creator, seq, timestamps, coin bits,
+  fork pairs, per-member tables).
 - ``tpu_swirld.tpu`` — the batched JAX/XLA consensus pipeline: blockwise
   boolean-matmul ancestry, fork-aware ``see``, member-hop strongly-see
   (MXU matmuls), witness/round scan, fame fixed point with coin rounds,
-  order extraction.  Bit-identical to the oracle by construction.
+  order extraction.  Bit-identical to the oracle (pinned by parity tests
+  on every BASELINE config shape).
 - ``tpu_swirld.parallel`` — SPMD sharding of the pipeline over a
-  ``jax.sharding.Mesh`` (members and event-blocks axes) with psum /
-  all_gather collectives.
+  ``jax.sharding.Mesh`` member axis with ``psum`` stake aggregation.
 - ``tpu_swirld.sim`` — in-process multi-node gossip simulation harness
-  (the reference's ``test(n_nodes, n_turns)``), plus a byzantine
-  fork-injecting adversary.
+  (the reference's ``test(n_nodes, n_turns)``), synthetic DAG generation
+  at benchmark scale, and two byzantine adversaries (consistent-order
+  fork injection + divergent equivocation).
+- ``tpu_swirld.checkpoint`` — packed-DAG and full-node save/restore.
+- ``tpu_swirld.metrics`` — per-phase timers, protocol gauges, profiler.
+- ``tpu_swirld.viz`` — per-event state export (both backends), JSON /
+  Graphviz / ASCII renderers.
+
+Consensus entry points: ``Node.consensus_pass`` (``backend='python'``)
+and ``tpu_swirld.tpu.run_consensus`` (``backend='tpu'``) consume the same
+gossip-delta / packed-DAG inputs and produce identical ``round`` /
+``witness`` / ``famous`` / consensus-order outputs (BASELINE north star).
 """
 
 from tpu_swirld.config import SwirldConfig
-from tpu_swirld.oracle.node import Node
 from tpu_swirld.oracle.event import Event
+from tpu_swirld.oracle.node import Node
 
-__version__ = "0.3.0"
+__version__ = "0.5.0"
 
 __all__ = ["SwirldConfig", "Node", "Event", "__version__"]

@@ -93,6 +93,7 @@ class Node:
         self.network = network
         self.network_want = network_want if network_want is not None else {}
         self._orphans: Dict[bytes, Event] = {}
+        self.bad_replies = 0  # malformed/mis-signed replies tolerated so far
         self.metrics = None   # set to metrics.Metrics() to enable counters
         self.members: List[bytes] = list(members)
         self.member_index: Dict[bytes, int] = {m: i for i, m in enumerate(members)}
@@ -412,7 +413,13 @@ class Node:
             if eid in self.hg:
                 continue
             if ev.p and any(p not in self.hg for p in ev.p):
-                if len(self._orphans) < self.config.max_orphans:
+                # park only events that are at least self-consistent (known
+                # creator, size caps, valid signature, parent arity) — junk
+                # must not be able to occupy the buffer; and evict FIFO when
+                # full so poisoning can't permanently disable recovery
+                if len(ev.p) == 2 and self._plausible(ev):
+                    if len(self._orphans) >= self.config.max_orphans:
+                        self._orphans.pop(next(iter(self._orphans)))
                     self._orphans[eid] = ev
                 continue
             try:
@@ -433,6 +440,17 @@ class Node:
                             progress = True
                     except ValueError:
                         pass   # invalid orphan: drop it
+
+    def _plausible(self, ev: Event) -> bool:
+        """Parent-independent validity: creator, size caps, signature."""
+        from tpu_swirld.oracle.event import MAX_KEY, MAX_PAYLOAD
+
+        return (
+            len(ev.d) <= MAX_PAYLOAD
+            and len(ev.c) <= MAX_KEY
+            and ev.c in self.member_index
+            and ev.verify()
+        )
 
     def _missing_parents(self) -> List[bytes]:
         return sorted(
@@ -456,9 +474,16 @@ class Node:
             len(self.member_events[m]).to_bytes(4, "little") for m in self.members
         )
         req = hv + crypto.sign(hv, self.sk, crypto.DOMAIN_SYNC_REQ)
-        reply = self.network[peer_pk](self.pk, req)
         new_ids: List[bytes] = []
-        self._ingest(self._decode_signed_blob(reply, peer_pk), new_ids)
+        try:
+            reply = self.network[peer_pk](self.pk, req)
+            events = self._decode_signed_blob(reply, peer_pk)
+        except ValueError:
+            # bad signature or malformed blob: a byzantine peer must not be
+            # able to kill our gossip loop — treat as a failed gossip round
+            self.bad_replies += 1
+            return new_ids
+        self._ingest(events, new_ids)
         # want-list recovery: bounded by DAG depth, capped defensively
         ask = self.network_want.get(peer_pk)
         for _ in range(self.config.max_want_rounds):
@@ -467,7 +492,11 @@ class Node:
                 break
             wv = b"".join(want)
             wreq = wv + crypto.sign(wv, self.sk, crypto.DOMAIN_WANT)
-            got = self._decode_signed_blob(ask(self.pk, wreq), peer_pk)
+            try:
+                got = self._decode_signed_blob(ask(self.pk, wreq), peer_pk)
+            except ValueError:
+                self.bad_replies += 1
+                break
             if not got:
                 break
             before = len(new_ids) + len(self._orphans)

@@ -1,0 +1,147 @@
+"""Pallas TPU kernels for the consensus pipeline's hot op.
+
+The strongly-sees matrix is the pipeline's FLOP bottleneck (Θ(N²·N/M·M)
+boolean-matmul work) and the kernel BASELINE.json's north star names
+("batched boolean matrix-power / BFS-style reachability kernel in
+Pallas").  The XLA path (:func:`tpu_swirld.tpu.pipeline.ssm_matrix`)
+re-gathers the per-member slabs and materializes an N×N int32 tally in
+HBM on every member iteration; this kernel instead
+
+- pre-gathers the member slabs ONCE into two dense operands with affine
+  block indexing:  ``A[N, M*K]`` ("x sees z", creator-grouped columns) and
+  ``B[M*K, N]`` ("z sees w"),
+- walks a ``(N/Tm, N/Tn, M)`` grid with the member axis innermost; the
+  per-tile stake tally lives in a VMEM scratch accumulator across the
+  member steps (TPU grids execute sequentially, so the scratch persists),
+- performs each member's ``(Tm,K)@(K,Tn)`` hop on the MXU in bfloat16
+  (0/1 products, f32 accumulation — exact), thresholds >0 into the
+  int32 stake tally on the VPU, and
+- writes the strict-2/3 supermajority bool tile exactly once, on the
+  last member step.
+
+HBM traffic: A is read N/Tn times, B N/Tm times, the output written once
+— the int32 tally never touches HBM (the XLA path rewrites it M times).
+
+Correctness is pinned against ``ssm_matrix`` by an interpret-mode parity
+test (``tests/test_pallas.py``); real-TPU timing is pending hardware
+availability (the axon tunnel did not initialize this round).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _ssm_kernel(stake_ref, a_ref, b_ref, out_ref, acc_ref, *, n_members,
+                tot_stake):
+    m = pl.program_id(2)
+
+    @pl.when(m == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    hit = (
+        jnp.dot(a_ref[:], b_ref[:], preferred_element_type=jnp.float32)
+        > 0.5
+    )
+    acc_ref[:] += hit.astype(jnp.int32) * stake_ref[m]
+
+    @pl.when(m == n_members - 1)
+    def _():
+        out_ref[:] = 3 * acc_ref[:] > 2 * tot_stake
+
+
+def ssm_matrix_pallas(
+    sees: jnp.ndarray,
+    member_table: jnp.ndarray,
+    stake: jnp.ndarray,
+    tot_stake: int,
+    matmul_dtype=jnp.bfloat16,
+    *,
+    tile_m: int = 256,
+    tile_n: int = 256,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Strongly-sees (∃-z rule) as a single Pallas kernel.  Drop-in
+    replacement for :func:`tpu_swirld.tpu.pipeline.ssm_matrix` (pass via
+    ``run_consensus(..., use_pallas_ssm=True)``)."""
+    n = sees.shape[0]
+    n_members, k = member_table.shape
+
+    def fit(t):
+        t = min(t, n)
+        while n % t:           # largest divisor of n at or below the request
+            t //= 2
+        if t < 8:
+            raise ValueError(f"no usable tile for n={n}")
+        return t
+
+    tile_m = fit(tile_m)
+    tile_n = fit(tile_n)
+    k_pad = max(128, ((k + 127) // 128) * 128)
+
+    idx = member_table.reshape(-1)
+    valid = idx >= 0
+    idxc = jnp.clip(idx, 0, n - 1)
+    # creator-grouped slabs, padded to (M, k_pad) columns/rows
+    a = (sees[:, idxc] & valid[None, :]).astype(matmul_dtype)      # N, M*k
+    b = (sees[idxc, :] & valid[:, None]).astype(matmul_dtype)      # M*k, N
+    if k_pad != k:
+        a = jnp.pad(
+            a.reshape(n, n_members, k), ((0, 0), (0, 0), (0, k_pad - k))
+        ).reshape(n, n_members * k_pad)
+        b = jnp.pad(
+            b.reshape(n_members, k, n), ((0, 0), (0, k_pad - k), (0, 0))
+        ).reshape(n_members * k_pad, n)
+
+    kernel = functools.partial(
+        _ssm_kernel, n_members=n_members, tot_stake=tot_stake
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n, n), jnp.bool_),
+        grid=(n // tile_m, n // tile_n, n_members),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),          # stake, whole
+            pl.BlockSpec(
+                (tile_m, k_pad),
+                lambda i, j, m: (i, m),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (k_pad, tile_n),
+                lambda i, j, m: (m, j),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (tile_m, tile_n),
+            lambda i, j, m: (i, j),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[pltpu.VMEM((tile_m, tile_n), jnp.int32)],
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+    )(stake.astype(jnp.int32), a, b)
+
+
+def make_ssm_fn(*, interpret: bool = False, tile_m: int = 256,
+                tile_n: int = 256):
+    """Adapter matching the ``ssm_fn`` seam of ``rounds_body``."""
+
+    def ssm_fn(sees, member_table, stake, tot_stake, dtype):
+        return ssm_matrix_pallas(
+            sees, member_table, stake, tot_stake, dtype,
+            tile_m=tile_m, tile_n=tile_n, interpret=interpret,
+        )
+
+    return ssm_fn

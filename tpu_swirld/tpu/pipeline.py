@@ -582,6 +582,25 @@ rounds_stage = functools.partial(
     ),
 )(rounds_body)
 
+_pallas_rounds_stages = {}
+
+
+def rounds_stage_pallas(interpret: bool):
+    """rounds_stage with the strongly-sees phase as the Pallas kernel."""
+    fn = _pallas_rounds_stages.get(interpret)
+    if fn is None:
+        from tpu_swirld.tpu.pallas_kernels import make_ssm_fn
+
+        fn = functools.partial(
+            jax.jit,
+            static_argnames=(
+                "tot_stake", "block", "r_max", "s_max", "has_forks",
+                "matmul_dtype_name",
+            ),
+        )(functools.partial(rounds_body, ssm_fn=make_ssm_fn(interpret=interpret)))
+        _pallas_rounds_stages[interpret] = fn
+    return fn
+
 fame_order_stage = functools.partial(
     jax.jit,
     static_argnames=(
@@ -697,6 +716,7 @@ def run_consensus(
     s_max: Optional[int] = None,
     matmul_dtype_name: Optional[str] = None,
     mesh=None,
+    use_pallas_ssm: bool = False,
 ) -> ConsensusResult:
     """Run the full pipeline on a packed DAG and extract the final order.
 
@@ -721,6 +741,11 @@ def run_consensus(
     chain = statics["chain"]
     tot = statics["tot_stake"]
     matmul_dtype_name = statics["matmul_dtype_name"]
+    if mesh is not None and use_pallas_ssm:
+        raise NotImplementedError(
+            "use_pallas_ssm is not yet routed through the sharded (mesh) "
+            "path; run one or the other"
+        )
     if mesh is not None:
         from tpu_swirld.parallel import consensus_fn_for_mesh, pad_members
 
@@ -766,8 +791,13 @@ def run_consensus(
     # rises at most once per own event), so the witness table is bounded
     # by chain+1 rounds; bucket to limit recompiles.
     r_rounds = min(r_max, _bucket(chain + 1, 32))
+    stage_a_fn = rounds_stage
+    if use_pallas_ssm:
+        stage_a_fn = rounds_stage_pallas(
+            interpret=jax.default_backend() != "tpu"
+        )
     t_dev0 = time.perf_counter()
-    stage_a = rounds_stage(
+    stage_a = stage_a_fn(
         jnp.asarray(parents),
         jnp.asarray(creator),
         jnp.asarray(stake),
