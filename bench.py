@@ -7,8 +7,8 @@ vs_baseline = speedup over the pure-Python oracle on the same machine
               (BASELINE.json north star: >= 50x on 64 members / 10k events).
 
 All detail goes to stderr.  Environment knobs:
-    BENCH_MEMBERS (64)  BENCH_EVENTS (10000)  BENCH_ORACLE_EVENTS (2500)
-    BENCH_TPU_PROBE_TIMEOUT (300 s)  BENCH_FORCE_CPU (unset)
+    BENCH_MEMBERS (64)  BENCH_EVENTS (10000)  BENCH_ORACLE_EVENTS (10000)
+    BENCH_TPU_PROBE_TIMEOUT (240 s)  BENCH_FORCE_CPU (unset)
 
 The machine's sitecustomize registers an 'axon' TPU-tunnel PJRT platform
 whose initialization has been observed to hang indefinitely; we therefore
@@ -24,8 +24,8 @@ import time
 
 MEMBERS = int(os.environ.get("BENCH_MEMBERS", "64"))
 EVENTS = int(os.environ.get("BENCH_EVENTS", "10000"))
-ORACLE_EVENTS = int(os.environ.get("BENCH_ORACLE_EVENTS", "2500"))
-PROBE_TIMEOUT = float(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "300"))
+ORACLE_EVENTS = int(os.environ.get("BENCH_ORACLE_EVENTS", "10000"))
+PROBE_TIMEOUT = float(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "240"))
 
 
 def log(*a):
@@ -73,7 +73,9 @@ def main():
     from tpu_swirld.sim import generate_gossip_dag
     from tpu_swirld.tpu.pipeline import run_consensus
 
-    n_events = EVENTS if tpu_ok else min(EVENTS, 4000)
+    n_events = EVENTS if tpu_ok else min(EVENTS, 10000)
+    if n_events != EVENTS:
+        log(f"[env] CPU fallback: clamping BENCH_EVENTS {EVENTS} -> {n_events}")
     t0 = time.time()
     members, stake, events, keys = generate_gossip_dag(MEMBERS, n_events, seed=1)
     log(f"[gen] {MEMBERS} members / {n_events} events in {time.time()-t0:.1f}s")

@@ -155,3 +155,47 @@ def test_parity_config4_64m_f21():
     result = run_consensus(packed, node.config)
     assert_parity(node, packed, result)
     assert sum(node.has_fork[m] for m in members) >= 15
+
+
+def test_columns_mode_matches_full():
+    """The column-restricted strongly-sees path must equal the full-matrix
+    path exactly (and both equal the oracle)."""
+    sim = make_simulation(6, seed=19)
+    sim.run(300)
+    node = sim.nodes[0]
+    packed = pack_node(node)
+    a = run_consensus(packed, node.config, block=64, ssm_mode="full")
+    b = run_consensus(packed, node.config, block=64, ssm_mode="columns")
+    assert (a.round == b.round).all()
+    assert (a.is_witness == b.is_witness).all()
+    assert a.famous == b.famous
+    assert a.order == b.order
+    assert (a.round_received == b.round_received).all()
+    assert (a.consensus_ts == b.consensus_ts).all()
+    assert_parity(node, packed, b)
+    assert b.timings["ssm_col_iterations"] < 64, "column loop must converge"
+
+
+def test_columns_mode_dense_two_member_dag():
+    """Degenerate round-per-event DAG (2-member alternating gossip): the
+    column loop's retry bound must cover one-round-per-chunk-row density
+    (review regression: cap of 64 crashed legal DAGs)."""
+    sim = make_simulation(2, seed=0)
+    for t in range(400):
+        sim.step(t % 2)
+    node = sim.nodes[0]
+    packed = pack_node(node)
+    a = run_consensus(packed, node.config, ssm_mode="full")
+    b = run_consensus(packed, node.config, ssm_mode="columns")
+    assert a.order == b.order and (a.round == b.round).all()
+    assert_parity(node, packed, b)
+
+
+def test_ssm_mode_validated():
+    import pytest as _pytest
+
+    sim = make_simulation(4, seed=1)
+    sim.run(40)
+    packed = pack_node(sim.nodes[0])
+    with _pytest.raises(ValueError):
+        run_consensus(packed, ssm_mode="colums")

@@ -417,7 +417,11 @@ class Node:
                 # creator, size caps, valid signature, parent arity) — junk
                 # must not be able to occupy the buffer; and evict FIFO when
                 # full so poisoning can't permanently disable recovery
-                if len(ev.p) == 2 and self._plausible(ev):
+                if (
+                    self.config.max_orphans > 0
+                    and len(ev.p) == 2
+                    and self._plausible(ev)
+                ):
                     if len(self._orphans) >= self.config.max_orphans:
                         self._orphans.pop(next(iter(self._orphans)))
                     self._orphans[eid] = ev

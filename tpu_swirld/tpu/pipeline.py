@@ -183,8 +183,6 @@ def ssm_matrix(
 
     acc = lax.fori_loop(0, n_members, body, jnp.zeros((n, n), dtype=jnp.int32))
     return 3 * acc > 2 * tot_stake
-
-
 # --------------------------------------------------------------- phase 4
 
 
@@ -204,14 +202,38 @@ def rounds_scan(
 
     Returns (round int32[N], is_witness bool[N], wit_table int32[r_max,
     s_max], wit_count int32[r_max], overflow bool[]).  Slot order within a
-    round is registration (= topo) order, as in the oracle.
+    round is registration (= topo) order, as in the oracle.  (The
+    column-restricted variant runs via ``rounds_chunk_stage`` /
+    ``_make_rounds_step`` with a ``col_pos`` map.)
     """
+    step = _make_rounds_step(
+        parents, ssm, creator, stake, tot_stake, n_valid,
+        r_max=r_max, s_max=s_max, has_forks=has_forks, col_pos=None,
+    )
+    n = parents.shape[0]
+    carry0 = (
+        jnp.zeros((n,), dtype=jnp.int32),
+        jnp.zeros((n,), dtype=bool),
+        jnp.full((r_max, s_max), -1, dtype=jnp.int32),
+        jnp.zeros((r_max,), dtype=jnp.int32),
+        jnp.zeros((), dtype=bool),
+    )
+    (rnd, wits, tab, cnt, overflow), _ = lax.scan(
+        step, carry0, jnp.arange(n)
+    )
+    return rnd, wits, tab, cnt, overflow
+
+
+def _make_rounds_step(parents, ssm, creator, stake, tot_stake, n_valid, *,
+                      r_max, s_max, has_forks, col_pos):
+    """The shared per-event body of the rounds scan.  Carry:
+    (rnd[N], wits[N], wit_table, wit_count, overflow)."""
     n = parents.shape[0]
     n_members = stake.shape[0]
     marange = jnp.arange(n_members)
 
     def step(carry, i):
-        rnd, tab, cnt, overflow = carry
+        rnd, wits, tab, cnt, overflow = carry
         p1 = parents[i, 0]
         p2 = parents[i, 1]
         genesis = p1 < 0
@@ -222,7 +244,15 @@ def rounds_scan(
         widx = tab[r0c]                                     # S
         wvalid = widx >= 0
         widxc = jnp.clip(widx, 0, n - 1)
-        ss = ssm[i, widxc] & wvalid                         # S
+        if col_pos is None:
+            ss = ssm[i, widxc] & wvalid                     # S
+        else:
+            wpos = col_pos[widxc]                           # S (-1 = absent)
+            ss = (
+                ssm[i, jnp.clip(wpos, 0, ssm.shape[1] - 1)]
+                & (wpos >= 0)
+                & wvalid
+            )
         if has_forks:
             wcre = creator[widxc]
             contrib = ((wcre[:, None] == marange[None, :]) & ss[:, None]).any(0)
@@ -242,18 +272,10 @@ def rounds_scan(
         tab = tab.at[rc, slotc].set(jnp.where(do, i, tab[rc, slotc]))
         cnt = cnt.at[rc].add(do.astype(jnp.int32))
         rnd = rnd.at[i].set(jnp.where(i < n_valid, r, 0))
-        return (rnd, tab, cnt, overflow), (r, is_wit)
+        wits = wits.at[i].set(is_wit)
+        return (rnd, wits, tab, cnt, overflow), None
 
-    carry0 = (
-        jnp.zeros((n,), dtype=jnp.int32),
-        jnp.full((r_max, s_max), -1, dtype=jnp.int32),
-        jnp.zeros((r_max,), dtype=jnp.int32),
-        jnp.zeros((), dtype=bool),
-    )
-    (rnd, tab, cnt, overflow), (rs, wits) = lax.scan(
-        step, carry0, jnp.arange(n)
-    )
-    return rnd, wits, tab, cnt, overflow
+    return step
 
 
 # --------------------------------------------------------------- phase 5
@@ -271,9 +293,13 @@ def fame_scan(
     matmul_dtype,
     *,
     has_forks: bool,
+    col_pos: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Virtual fame voting.  Returns famous int8[r_max*s_max] over global
     witness slots (row-major (round, slot)): 1 famous, 0 not, -1 undecided.
+
+    With ``col_pos``, ``ssm`` is column-restricted (every queried column is
+    a witness, so the map is total here — guaranteed by the host loop).
     """
     r_max, s_max = wit_table.shape
     n = sees.shape[0]
@@ -300,7 +326,15 @@ def fame_scan(
         p_idx = wit_table[ry - 1]
         p_valid = p_idx >= 0
         pe = jnp.clip(p_idx, 0, n - 1)
-        ssy = ssm[ye][:, pe] & y_valid[:, None] & p_valid[None, :]   # S,S
+        if col_pos is None:
+            ssy = ssm[ye][:, pe]                        # S,S
+        else:
+            ppos = col_pos[pe]
+            ssy = (
+                ssm[ye][:, jnp.clip(ppos, 0, ssm.shape[1] - 1)]
+                & (ppos >= 0)[None, :]
+            )
+        ssy = ssy & y_valid[:, None] & p_valid[None, :]
         pcre = creator[pe]                              # S
         pstake = jnp.where(p_valid, stake[pcre], 0)
         if exact_tally:
@@ -582,6 +616,115 @@ rounds_stage = functools.partial(
     ),
 )(rounds_body)
 
+
+# --- column-restricted strongly-sees path (default single-host execution):
+# visibility once, then an iterated {ssm columns -> rounds scan} loop on the
+# host until every registered witness has a column (exactness certificate),
+# then fame/order with the position-mapped restricted matrix.
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_members", "block", "matmul_dtype_name")
+)
+def visibility_stage(parents, creator, fork_pairs, *, n_members, block,
+                     matmul_dtype_name):
+    dt = jnp.bfloat16 if matmul_dtype_name == "bfloat16" else jnp.float32
+    anc = ancestry(parents, block=block, matmul_dtype=dt)
+    fseen = forkseen_matrix(anc, fork_pairs, n_members, dt)
+    sees = sees_matrix(anc, fseen, creator)
+    return anc, sees
+
+
+@functools.partial(jax.jit, static_argnames=())
+def member_slabs(sees, member_table):
+    """Pre-gathered per-member visibility slabs for the column kernel:
+    A3[m] = "x sees z" for member m's events (N, K) and B3[m] = "z sees w"
+    (K, N) — gathered from the N×N sees matrix exactly once."""
+    n = sees.shape[0]
+    idx = member_table.reshape(-1)
+    valid = idx >= 0
+    idxc = jnp.clip(idx, 0, n - 1)
+    m, k = member_table.shape
+    a3 = (sees[:, idxc] & valid[None, :]).reshape(n, m, k).transpose(1, 0, 2)
+    b3 = (sees[idxc, :] & valid[:, None]).reshape(m, k, n)
+    return a3, b3
+
+
+@functools.partial(
+    jax.jit, static_argnames=("tot_stake", "matmul_dtype_name")
+)
+def ssm_cols_stage(a3, b3, stake, cols, *, tot_stake, matmul_dtype_name):
+    """Strongly-sees columns from pre-gathered slabs: one batched matmul
+    (M, N, K) @ (M, K, C), per-member >0 threshold, int32 stake tally."""
+    dt = jnp.bfloat16 if matmul_dtype_name == "bfloat16" else jnp.float32
+    n = a3.shape[1]
+    n_members = a3.shape[0]
+    colsc = jnp.clip(cols, 0, n - 1)
+    col_valid = cols >= 0
+    b_cols = b3[:, :, colsc] & col_valid[None, None, :]      # M,K,C
+
+    def body(m, acc):                     # per-member (N,K)@(K,C) hop; the
+        hit = _bmm(a3[m], b_cols[m], dt)  # (N,C) tally never leaves VMEM/HBM
+        return acc + stake[m] * hit.astype(jnp.int32)
+
+    acc = lax.fori_loop(
+        0, n_members, body,
+        jnp.zeros((n, cols.shape[0]), dtype=jnp.int32),
+    )
+    return (3 * acc > 2 * tot_stake) & col_valid[None, :]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("tot_stake", "r_max", "s_max", "has_forks", "chunk"),
+)
+def rounds_chunk_stage(parents, ssm_c, col_pos, creator, stake, n_valid,
+                       rnd, wits, tab, cnt, overflow, start, *,
+                       tot_stake, r_max, s_max, has_forks, chunk):
+    """One chunk of the rounds scan: events [start, start+chunk) resume
+    from the carried (rnd, wits, tab, cnt, overflow) state.  Shares the
+    per-event body with rounds_scan — used by the incremental
+    column-restricted path."""
+    step = _make_rounds_step(
+        parents, ssm_c, creator, stake, tot_stake, n_valid,
+        r_max=r_max, s_max=s_max, has_forks=has_forks, col_pos=col_pos,
+    )
+    carry0 = (rnd, wits, tab, cnt, overflow)
+    (rnd, wits, tab, cnt, overflow), _ = lax.scan(
+        step, carry0, start + jnp.arange(chunk)
+    )
+    return rnd, wits, tab, cnt, overflow
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "tot_stake", "coin_period", "r_max", "s_max", "chain", "has_forks",
+        "matmul_dtype_name",
+    ),
+)
+def fame_order_cols_stage(
+    anc, sees, ssm_c, col_pos, wit_table, wit_count, creator, coin, stake,
+    self_parent, t_rank, max_round, n_valid, *,
+    tot_stake, coin_period, r_max, s_max, chain, has_forks,
+    matmul_dtype_name,
+):
+    dt = jnp.bfloat16 if matmul_dtype_name == "bfloat16" else jnp.float32
+    tab = wit_table[:r_max]
+    cnt = wit_count[:r_max]
+    famous = fame_scan(
+        tab, sees, ssm_c, creator, coin, stake, tot_stake, coin_period, dt,
+        has_forks=has_forks, col_pos=col_pos,
+    )
+    rr, cts_rank = order_scan(
+        anc, tab, cnt, famous, creator, self_parent, t_rank, max_round,
+        n_valid, chain=chain,
+    )
+    return {
+        "famous": famous, "round_received": rr,
+        "consensus_ts_rank": cts_rank,
+    }
+
 _pallas_rounds_stages = {}
 
 
@@ -717,6 +860,7 @@ def run_consensus(
     matmul_dtype_name: Optional[str] = None,
     mesh=None,
     use_pallas_ssm: bool = False,
+    ssm_mode: str = "columns",
 ) -> ConsensusResult:
     """Run the full pipeline on a packed DAG and extract the final order.
 
@@ -741,6 +885,8 @@ def run_consensus(
     chain = statics["chain"]
     tot = statics["tot_stake"]
     matmul_dtype_name = statics["matmul_dtype_name"]
+    if ssm_mode not in ("columns", "full"):
+        raise ValueError(f"unknown ssm_mode {ssm_mode!r}")
     if mesh is not None and use_pallas_ssm:
         raise NotImplementedError(
             "use_pallas_ssm is not yet routed through the sharded (mesh) "
@@ -791,6 +937,13 @@ def run_consensus(
     # rises at most once per own event), so the witness table is bounded
     # by chain+1 rounds; bucket to limit recompiles.
     r_rounds = min(r_max, _bucket(chain + 1, 32))
+    if ssm_mode == "columns" and not use_pallas_ssm:
+        return _run_consensus_columns(
+            packed, config, parents, creator, t_rank, coin, stake,
+            member_table, ts_unique, n=n, tot=tot, block=block,
+            r_rounds=r_rounds, s_max=s_max, chain=chain,
+            matmul_dtype_name=matmul_dtype_name,
+        )
     stage_a_fn = rounds_stage
     if use_pallas_ssm:
         stage_a_fn = rounds_stage_pallas(
@@ -853,6 +1006,167 @@ def run_consensus(
     result.timings = {
         "device_and_dispatch": round(t_device, 6),
         "finalize_host": round(time.perf_counter() - t_fin0, 6),
+    }
+    return result
+
+
+def _run_consensus_columns(
+    packed, config, parents, creator, t_rank, coin, stake, member_table,
+    ts_unique, *, n, tot, block, r_rounds, s_max, chain, matmul_dtype_name,
+):
+    """Column-restricted strongly-sees execution (the default path).
+
+    Strongly-see columns are pure DAG functions (round-independent), and
+    the rounds scan only queries *witness* columns, so instead of the full
+    Θ(N³) matrix we compute columns only as witnesses are discovered: the
+    scan runs in chunks carrying its state; when a chunk registers a
+    witness that has no column yet, the column is computed and just that
+    chunk re-runs (exact, because columns don't depend on rounds).  Every
+    query in the final pass over each chunk was answered exactly, so the
+    result is bit-identical to the full-matrix scan at Θ(N²·W) cost
+    (W ≈ 10% of N in gossip DAGs).
+    """
+    n_pad = parents.shape[0]
+    has_forks = bool(len(packed.fork_pairs))
+    t_dev0 = time.perf_counter()
+    parents_d = jnp.asarray(parents)
+    creator_d = jnp.asarray(creator)
+    stake_d = jnp.asarray(stake)
+    mt_d = jnp.asarray(member_table)
+    n_d = jnp.asarray(n, dtype=jnp.int32)
+    anc, sees = visibility_stage(
+        parents_d, creator_d, jnp.asarray(packed.fork_pairs),
+        n_members=int(stake.shape[0]), block=block,
+        matmul_dtype_name=matmul_dtype_name,
+    )
+    a3, b3 = member_slabs(sees, mt_d)
+
+    # incremental column store: a preallocated (N, W_CAP) buffer written
+    # in place so the scan's input shape stays stable (W_CAP grows in
+    # 1024-buckets only); positions tracked host-side.  Every column is
+    # exact regardless of round state.
+    col_pos = np.full((n_pad,), -1, dtype=np.int32)
+    n_cols = 0
+    w_cap = min(_bucket(max(s_max * 8, 256), 256), n_pad)
+    ssm_c = jnp.zeros((n_pad, w_cap), dtype=bool)
+    n_scans = 0
+
+    def add_columns(events):
+        nonlocal n_cols, ssm_c, w_cap
+        batch = _bucket(len(events), 16)
+        if n_cols + batch > w_cap:
+            w_cap = _bucket(
+                max(n_cols + batch, min(w_cap * 2, n_pad)), 256
+            )
+            ssm_c = jnp.pad(ssm_c, ((0, 0), (0, w_cap - ssm_c.shape[1])))
+        cols_arr = np.full((batch,), -1, dtype=np.int32)
+        cols_arr[: len(events)] = events
+        part = ssm_cols_stage(
+            a3, b3, stake_d, jnp.asarray(cols_arr), tot_stake=tot,
+            matmul_dtype_name=matmul_dtype_name,
+        )
+        for j, e in enumerate(events):
+            col_pos[e] = n_cols + j
+        ssm_c = lax.dynamic_update_slice(ssm_c, part, (0, n_cols))
+        n_cols += batch
+
+    add_columns([int(i) for i in np.where(packed.parents[:, 0] < 0)[0]])
+
+    # chunked scan: resume from the carried state; when a chunk registers
+    # a witness whose column is missing AND a later event in the chunk
+    # queried that witness's round, compute the column and re-run just
+    # that chunk (columns are round-independent, so the re-run is exact);
+    # otherwise the chunk's outputs are already exact and the new columns
+    # only serve future chunks.
+    chunk_size = min(128, n_pad)
+    while n_pad % chunk_size:
+        chunk_size //= 2
+    state = (
+        jnp.zeros((n_pad,), dtype=jnp.int32),
+        jnp.zeros((n_pad,), dtype=bool),
+        jnp.full((r_rounds, s_max), -1, dtype=jnp.int32),
+        jnp.zeros((r_rounds,), dtype=jnp.int32),
+        jnp.zeros((), dtype=bool),
+    )
+    parents_np = parents
+    for start in range(0, n_pad, chunk_size):
+        start_d = jnp.asarray(start, dtype=jnp.int32)
+        # each failed attempt adds at least one column, and a chunk can
+        # register at most chunk_size witnesses, so this bound is safe
+        # even for degenerate one-round-per-event DAGs (2-member gossip)
+        for _attempt in range(chunk_size + 1):
+            out = rounds_chunk_stage(
+                parents_d, ssm_c, jnp.asarray(col_pos), creator_d,
+                stake_d, n_d, *state, start_d,
+                tot_stake=tot, r_max=r_rounds, s_max=s_max,
+                has_forks=has_forks, chunk=chunk_size,
+            )
+            n_scans += 1
+            tab = np.asarray(out[2])
+            registered = np.unique(tab[tab >= 0])
+            missing = registered[col_pos[registered] < 0]
+            if missing.size == 0:
+                state = out
+                break
+            rnd_np = np.asarray(out[0])
+            # was any missing witness's round queried later in this chunk?
+            ce = np.arange(start, start + chunk_size)
+            p = parents_np[ce]
+            r0 = np.where(
+                p[:, 0] < 0,
+                -1,
+                np.maximum(rnd_np[np.maximum(p[:, 0], 0)],
+                           rnd_np[np.maximum(p[:, 1], 0)]),
+            )
+            affected = False
+            for w in missing:
+                if w < start:       # registered in an earlier chunk state?
+                    affected = True  # (shouldn't happen; be safe)
+                    break
+                later = ce > w
+                if np.any(later & (r0 == rnd_np[w])):
+                    affected = True
+                    break
+            add_columns([int(e) for e in missing])
+            if not affected:
+                state = out
+                break
+        else:
+            raise RuntimeError("witness-column chunk did not converge")
+    rnd_a, wits_a, tab_a, cnt_a, overflow_a = state
+    if bool(overflow_a):
+        raise RuntimeError(
+            "witness table overflow: raise config.max_rounds / s_max"
+        )
+    max_round_d = jnp.max(jnp.where(jnp.arange(n_pad) < n_d, rnd_a, 0))
+    max_round = int(max_round_d)
+    r_tight = min(r_rounds, _bucket(max_round + 3, 8))
+    stage_b = fame_order_cols_stage(
+        anc, sees, ssm_c, jnp.asarray(col_pos), tab_a, cnt_a,
+        creator_d, jnp.asarray(coin), stake_d,
+        jnp.asarray(parents[:, 0]), jnp.asarray(t_rank),
+        max_round_d, n_d,
+        tot_stake=tot, coin_period=config.coin_period, r_max=r_tight,
+        s_max=s_max, chain=chain, has_forks=has_forks,
+        matmul_dtype_name=matmul_dtype_name,
+    )
+    out = {
+        "round": rnd_a,
+        "is_witness": wits_a,
+        "wit_table": tab_a[:r_tight],
+        "wit_count": cnt_a[:r_tight],
+        "max_round": max_round_d,
+        **stage_b,
+    }
+    out = jax.tree.map(np.asarray, out)
+    t_device = time.perf_counter() - t_dev0
+    t_fin0 = time.perf_counter()
+    result = finalize_order(packed, out, ts_unique)
+    result.timings = {
+        "device_and_dispatch": round(t_device, 6),
+        "finalize_host": round(time.perf_counter() - t_fin0, 6),
+        "ssm_columns": n_cols,
+        "ssm_col_iterations": n_scans,
     }
     return result
 
