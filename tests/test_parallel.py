@@ -47,3 +47,21 @@ def test_sharded_matches_unsharded():
     assert (a.is_witness == b.is_witness).all()
     assert a.famous == b.famous
     assert a.order == b.order
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_config5_shape_256_members_sharded():
+    """BASELINE config 5 shape (256 members, member-sharded) at reduced
+    event count: sharded(8) == unsharded, and ordering is live.  Full
+    100k-event scale additionally needs event-axis blocking (roadmap)."""
+    from tpu_swirld.packing import pack_events
+    from tpu_swirld.sim import generate_gossip_dag
+
+    members, stake, events, keys = generate_gossip_dag(256, 3000, seed=6)
+    packed = pack_events(events, members, stake)
+    a = run_consensus(packed, ssm_mode="full")
+    b = run_consensus(packed, mesh=make_mesh(8), ssm_mode="full")
+    assert (a.round == b.round).all()
+    assert a.famous == b.famous
+    assert a.order == b.order

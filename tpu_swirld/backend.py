@@ -1,0 +1,118 @@
+"""The pluggable ``backend='tpu'`` consensus engine for a live Node.
+
+BASELINE.json pins the seam: the TPU path is "gated behind the existing
+``Node.divide_rounds``/``decide_fame``/``find_order`` interface as a
+pluggable ``backend='tpu'`` strategy" consuming the same gossip-sync
+deltas.  This module implements that gate:
+
+- a :class:`TpuEngine` owns an incremental :class:`~tpu_swirld.packing.
+  Packer` mirroring the node's event store;
+- each ``consensus_pass`` appends the sync delta and re-runs the batched
+  device pipeline over the full packed DAG (consensus outputs are pure
+  functions of the DAG, so batch == incremental — the same purity argument
+  the oracle relies on);
+- the device outputs are written back into the node's oracle-shaped state
+  (``round`` / ``is_witness`` / ``wit_list`` / ``famous`` /
+  ``round_received`` / ``consensus_ts`` / ``consensus`` / ``transactions``)
+  so everything downstream (viz export, metrics gauges, checkpointing,
+  other members gossiping with this node) is backend-agnostic.
+
+``config.mesh_shape`` (e.g. ``{"members": 8}``) runs the strongly-sees
+phase shard_map'd over a device mesh; ``config.block_size`` sets the
+ancestry tile.  A python-backend and a tpu-backend node interoperate in
+one simulation and reach identical consensus prefixes
+(``tests/test_backend.py``).
+
+Caveat (documented, inherent to full-batch replay): each pass recomputes
+from the whole DAG, so per-sync cost grows with history; for long-lived
+nodes run passes periodically or at checkpoints.  The oracle remains the
+low-latency per-sync engine; the device backend is the throughput engine.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from tpu_swirld.packing import Packer
+from tpu_swirld.tpu.pipeline import ConsensusResult, run_consensus
+
+
+class TpuEngine:
+    """Device-pipeline consensus engine bound to one Node."""
+
+    def __init__(self, node):
+        self.node = node
+        stake = [node.stake[m] for m in node.members]
+        self.packer = Packer(node.members, stake)
+        self.mesh = None
+        if node.config.mesh_shape:
+            from tpu_swirld.parallel import make_mesh
+
+            n_dev = 1
+            for v in node.config.mesh_shape.values():
+                n_dev *= int(v)
+            self.mesh = make_mesh(n_dev)
+        self.last_result: Optional[ConsensusResult] = None
+
+    def consensus_pass(self, new_ids: List[bytes]) -> None:
+        node = self.node
+        for eid in node.order_added[len(self.packer):]:
+            self.packer.append(node.hg[eid])
+        packed = self.packer.pack()
+        result = run_consensus(
+            packed,
+            node.config,
+            block=node.config.block_size,
+            mesh=self.mesh,
+        )
+        self.last_result = result
+        self._write_back(packed, result)
+
+    def _write_back(self, packed, result: ConsensusResult) -> None:
+        """Mirror device outputs into the node's oracle-shaped state."""
+        node = self.node
+        ids = packed.ids
+        node.round = {ids[i]: int(result.round[i]) for i in range(packed.n)}
+        node.is_witness = {
+            ids[i]: bool(result.is_witness[i]) for i in range(packed.n)
+        }
+        node.max_round = result.max_round
+        node.famous = {
+            ids[i]: v for i, v in result.famous.items()
+        }
+        # witness tables in slot order (device slot order == topo order)
+        node.wit_list = {}
+        node.wit_slot = {}
+        node.witnesses = {}
+        for i in sorted(result.famous):
+            eid = ids[i]
+            r = int(result.round[i])
+            slots = node.wit_list.setdefault(r, [])
+            node.wit_slot[eid] = len(slots)
+            slots.append(eid)
+            node.witnesses.setdefault(r, {}).setdefault(
+                node.hg[eid].c, []
+            ).append(eid)
+        # ordering state
+        node.round_received = {}
+        node.consensus_ts = {}
+        consensus: List[bytes] = []
+        for i in result.order:
+            eid = ids[i]
+            node.round_received[eid] = int(result.round_received[i])
+            node.consensus_ts[eid] = int(result.consensus_ts[i])
+            consensus.append(eid)
+        node.consensus = consensus
+        node.transactions = [node.hg[e].d for e in consensus]
+        node.tbd = [e for e in node.order_added if e not in node.round_received]
+        # fame-complete prefix (the rounds order extraction consumed)
+        r = 0
+        while True:
+            ws = node.wit_list.get(r)
+            if not ws or node.max_round < r + 2:
+                break
+            if any(node.famous[w] is None for w in ws):
+                break
+            r += 1
+        node.consensus_round = r
+        node._frozen_round = r - 1

@@ -95,6 +95,7 @@ class Node:
         self._orphans: Dict[bytes, Event] = {}
         self.bad_replies = 0  # malformed/mis-signed replies tolerated so far
         self.metrics = None   # set to metrics.Metrics() to enable counters
+        self._tpu_engine = None   # lazily built when config.backend == "tpu"
         self.members: List[bytes] = list(members)
         self.member_index: Dict[bytes, int] = {m: i for i, m in enumerate(members)}
         stakes = self.config.stakes()
@@ -717,7 +718,26 @@ class Node:
     # ------------------------------------------------------------- main loop
 
     def consensus_pass(self, new_ids: List[bytes]) -> None:
-        """The three consensus calls in reference order (the pluggable seam)."""
+        """The three consensus calls in reference order — the pluggable
+        seam.  ``config.backend == "tpu"`` routes the pass through the
+        batched device pipeline (:mod:`tpu_swirld.backend`), producing
+        bit-identical state."""
+        if self.config.backend == "tpu":
+            if self._tpu_engine is None:
+                from tpu_swirld.backend import TpuEngine
+
+                self._tpu_engine = TpuEngine(self)
+            if self.metrics is None:
+                self._tpu_engine.consensus_pass(new_ids)
+            else:
+                before = len(self.consensus)
+                with self.metrics.phase("tpu_pipeline"):
+                    self._tpu_engine.consensus_pass(new_ids)
+                self.metrics.count("events_processed", len(new_ids))
+                self.metrics.count(
+                    "events_ordered", len(self.consensus) - before
+                )
+            return
         if self.metrics is None:
             self.divide_rounds(new_ids)
             self.decide_fame()

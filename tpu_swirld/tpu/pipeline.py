@@ -860,7 +860,7 @@ def run_consensus(
     matmul_dtype_name: Optional[str] = None,
     mesh=None,
     use_pallas_ssm: bool = False,
-    ssm_mode: str = "columns",
+    ssm_mode: Optional[str] = None,
 ) -> ConsensusResult:
     """Run the full pipeline on a packed DAG and extract the final order.
 
@@ -885,13 +885,22 @@ def run_consensus(
     chain = statics["chain"]
     tot = statics["tot_stake"]
     matmul_dtype_name = statics["matmul_dtype_name"]
-    if ssm_mode not in ("columns", "full"):
+    if ssm_mode not in (None, "columns", "full"):
         raise ValueError(f"unknown ssm_mode {ssm_mode!r}")
     if mesh is not None and use_pallas_ssm:
         raise NotImplementedError(
             "use_pallas_ssm is not yet routed through the sharded (mesh) "
             "path; run one or the other"
         )
+    if ssm_mode == "columns" and (mesh is not None or use_pallas_ssm):
+        raise NotImplementedError(
+            "ssm_mode='columns' is not routed through the mesh/pallas "
+            "paths yet; those run the full-matrix kernel"
+        )
+    if ssm_mode is None:
+        # auto: column-restricted on the plain single-host path, full
+        # matrix for the fused mesh / pallas kernels
+        ssm_mode = "full" if (mesh is not None or use_pallas_ssm) else "columns"
     if mesh is not None:
         from tpu_swirld.parallel import consensus_fn_for_mesh, pad_members
 
@@ -899,6 +908,9 @@ def run_consensus(
             member_table, stake, mesh.devices.size
         )
         kernel = consensus_fn_for_mesh(mesh)
+        # max_round never exceeds the longest self-chain; bound the fused
+        # kernel's witness table accordingly (same bound as the staged path)
+        r_max = min(r_max, _bucket(chain + 1, 32))
         out = kernel(
             jnp.asarray(parents),
             jnp.asarray(creator),
@@ -1053,6 +1065,8 @@ def _run_consensus_columns(
 
     def add_columns(events):
         nonlocal n_cols, ssm_c, w_cap
+        # bucket only the matmul batch and the buffer CAPACITY; occupancy
+        # advances by the real count so padding slots are reused
         batch = _bucket(len(events), 16)
         if n_cols + batch > w_cap:
             w_cap = _bucket(
@@ -1068,7 +1082,7 @@ def _run_consensus_columns(
         for j, e in enumerate(events):
             col_pos[e] = n_cols + j
         ssm_c = lax.dynamic_update_slice(ssm_c, part, (0, n_cols))
-        n_cols += batch
+        n_cols += len(events)
 
     add_columns([int(i) for i in np.where(packed.parents[:, 0] < 0)[0]])
 
