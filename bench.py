@@ -102,6 +102,8 @@ def main():
     packed_full = pack_events(events, members, stake)
     log(f"[pack] {time.time()-t0:.2f}s")
 
+    if n_oracle == n_events:
+        packed_prefix = packed_full
     res_prefix = run_consensus(packed_prefix, node.config)
     parity = (
         [packed_prefix.ids[i] for i in res_prefix.order] == node.consensus

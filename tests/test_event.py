@@ -55,3 +55,14 @@ def test_sim_crypto_backend_roundtrip():
         assert not crypto.verify(b"other", sig, pk)
     finally:
         crypto.set_backend("ed25519")
+
+
+def test_crypto_randrange_bounds():
+    from tpu_swirld import crypto
+
+    import pytest
+    for n in (1, 2, 7, 1000):
+        for _ in range(20):
+            assert 0 <= crypto.randrange(n) < n
+    with pytest.raises(ValueError):
+        crypto.randrange(0)

@@ -199,3 +199,46 @@ def test_ssm_mode_validated():
     packed = pack_node(sim.nodes[0])
     with _pytest.raises(ValueError):
         run_consensus(packed, ssm_mode="colums")
+
+
+def test_parity_huge_stake_exact_tally():
+    """tot_stake >= 2^24 forces the exact int32 per-creator fame tally
+    (the fast f32 path would round) — parity must hold."""
+    from tpu_swirld.config import SwirldConfig
+
+    big = 1 << 23
+    cfg = SwirldConfig(n_members=4, stake=(big, big, big, big), seed=2)
+    sim = make_simulation(4, seed=2, config=cfg)
+    sim.run(200)
+    node = sim.nodes[0]
+    packed = pack_node(node)
+    assert int(packed.stake.sum()) >= (1 << 24)
+    result = run_consensus(packed, node.config, block=64)
+    assert_parity(node, packed, result)
+    assert len(node.consensus) > 0
+
+
+def test_parity_three_members_supermajority_edge():
+    """n=3: supermajority needs all... 3*2 > 2*3 means 2-of-3 suffices;
+    the smallest population where consensus can advance."""
+    sim = make_simulation(3, seed=8)
+    sim.run(200)
+    node = sim.nodes[0]
+    packed = pack_node(node)
+    result = run_consensus(packed, node.config, block=64)
+    assert_parity(node, packed, result)
+    assert len(node.consensus) > 0
+
+
+def test_pipeline_trivial_dags():
+    """Geneses-only and single-member DAGs must not crash either backend."""
+    from tpu_swirld.oracle.node import Node
+    from tpu_swirld.packing import pack_events
+    from tpu_swirld.sim import generate_gossip_dag
+
+    members, stake, events, keys = generate_gossip_dag(4, 4, seed=0)
+    packed = pack_events(events, members, stake)   # geneses only
+    result = run_consensus(packed, block=64)
+    assert list(result.round) == [0, 0, 0, 0]
+    assert result.is_witness.all()
+    assert result.order == []

@@ -140,3 +140,14 @@ def verify(body: bytes, sig: bytes, pk: bytes, domain: bytes = b"") -> bool:
 def coin_bit(sig: bytes) -> int:
     """Pseudo-random coin-round bit: low bit of the signature's middle byte."""
     return sig[len(sig) // 2] & 1
+
+
+def randrange(n: int) -> int:
+    """Uniform int in [0, n) from the OS CSPRNG (the reference's
+    crypto-safe ``randrange`` in ``utils.py`` — used for peer selection
+    outside deterministic simulations)."""
+    import secrets
+
+    if n <= 0:
+        raise ValueError("randrange needs n > 0")
+    return secrets.randbelow(n)
