@@ -8,78 +8,55 @@ agreement, fork detection, backend equivalence, restore fidelity.
 """
 
 import dataclasses
-import random
 
 import pytest
 
-from tpu_swirld import crypto
-from tpu_swirld.checkpoint import load_node, save_node
-from tpu_swirld.config import SwirldConfig
-from tpu_swirld.oracle.node import Node
-from tpu_swirld.sim import DivergentForker
+from tpu_swirld.checkpoint import load_node
+from tpu_swirld.sim import run_with_divergent_forkers
 
 
 @pytest.mark.slow
 def test_mixed_backend_byzantine_soak(tmp_path):
-    n_nodes, n_forkers, n_turns = 7, 2, 420
-    config = SwirldConfig(n_members=n_nodes, seed=77)
-    rng = random.Random(77)
-    keys = [crypto.keypair(b"soak-%d" % i) for i in range(n_nodes)]
-    members = [pk for pk, _ in keys]
-    network, network_want, clock = {}, {}, [0]
-    forkers, honest = [], []
-    for i, (pk, sk) in enumerate(keys):
-        if i < n_forkers:
-            f = DivergentForker(
-                sk, pk, members, network, network_want, config,
-                lambda: clock[0], rng,
-            )
-            network[pk], network_want[pk] = f.ask_sync, f.ask_events
-            forkers.append(f)
-        else:
-            cfg = config
-            if i == n_forkers:   # one honest member runs the device engine
-                cfg = dataclasses.replace(
-                    config, backend="tpu", block_size=128
-                )
-            node = Node(
-                sk=sk, pk=pk, network=network, members=members, config=cfg,
-                clock=lambda: clock[0], network_want=network_want,
-            )
-            network[pk], network_want[pk] = node.ask_sync, node.ask_events
-            honest.append(node)
-    honest_pks = [n.pk for n in honest]
-    tpu_node = honest[0]
+    n_turns = 420
     ckpt = str(tmp_path / "mid.swck")
-    for turn in range(n_turns):
-        clock[0] += 1
-        node = honest[rng.randrange(len(honest))]
-        peers = [pk for pk in members if pk != node.pk]
-        peer = peers[rng.randrange(len(peers))]
-        new_ids = node.sync(peer, b"tx:%d" % turn)
-        node.consensus_pass(new_ids)
-        if turn == n_turns // 2:
-            save_node(ckpt, tpu_node)
-        if turn % 3 == 0:
-            for f in forkers:
-                f.step(honest_pks)
+    saved = {}
+
+    def node_config(i, base):
+        # honest member index 2 (first honest slot) runs the device engine
+        if i == 2:
+            return dataclasses.replace(base, backend="tpu", block_size=128)
+        return base
+
+    def on_turn(turn, honest):
+        if turn == n_turns // 2 and not saved:
+            from tpu_swirld.checkpoint import save_node
+
+            save_node(ckpt, honest[0])
+            saved["done"] = True
+
+    sim = run_with_divergent_forkers(
+        7, 2, n_turns, seed=77, fork_every=3,
+        node_config=node_config, on_turn=on_turn,
+    )
+    honest = sim.nodes
+    tpu_node = honest[0]
+    assert tpu_node._tpu_engine is not None, "device engine must have run"
 
     # 1. honest prefix agreement across backends
     orders = [n.consensus for n in honest]
     m = min(len(o) for o in orders)
     assert m > 0, "consensus must stay live"
     assert all(o[:m] == orders[0][:m] for o in orders)
-    # 2. the tpu-backend node ordered events and detected a fork somewhere
+    # 2. the tpu-backend node ordered events and a fork was detected
     assert len(tpu_node.consensus) > 0
-    forker_pks = {f.pk for f in forkers}
+    forker_pks = {f.pk for f in sim.forkers}
     assert any(n.has_fork[p] for n in honest for p in forker_pks)
-    # 3. mid-stream checkpoint restores to a python replay with identical
-    #    state, and the restored node keeps gossiping
+    # 3. the mid-stream checkpoint restores to an exact prefix of the live
+    #    node's final state and keeps gossiping
+    assert saved
     restored = load_node(
-        ckpt, sk=tpu_node.sk, pk=tpu_node.pk, network=network,
-        network_want=network_want,
+        ckpt, sk=tpu_node.sk, pk=tpu_node.pk, network=sim.network,
     )
-    # the mid-stream state must be a prefix of the live node's final state
     k = len(restored.consensus)
     assert restored.consensus == tpu_node.consensus[:k]
     peer = honest[1].pk

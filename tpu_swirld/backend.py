@@ -53,11 +53,21 @@ class TpuEngine:
                 n_dev *= int(v)
             self.mesh = make_mesh(n_dev)
         self.last_result: Optional[ConsensusResult] = None
+        self._n_consumed = 0
 
-    def consensus_pass(self, new_ids: List[bytes]) -> None:
+    def consensus_pass(self, new_ids: List[bytes], force: bool = False) -> None:
         node = self.node
         for eid in node.order_added[len(self.packer):]:
             self.packer.append(node.hg[eid])
+        # lazy batching: amortize the batch replay over >= tpu_min_batch
+        # new events (identical eventual output — consensus is a pure
+        # function of the DAG — just computed later)
+        pending = len(self.packer) - self._n_consumed
+        if pending == 0 or len(self.packer) == 0:
+            return    # up to date: nothing a replay could change
+        if not force and pending < max(1, node.config.tpu_min_batch):
+            return
+        self._n_consumed = len(self.packer)
         packed = self.packer.pack()
         result = run_consensus(
             packed,
@@ -67,6 +77,11 @@ class TpuEngine:
         )
         self.last_result = result
         self._write_back(packed, result)
+
+    def flush(self) -> None:
+        """Run any pending events through a device pass now, ignoring the
+        lazy-batch threshold (no-op when already up to date)."""
+        self.consensus_pass([], force=True)
 
     def _write_back(self, packed, result: ConsensusResult) -> None:
         """Mirror device outputs into the node's oracle-shaped state."""

@@ -140,4 +140,8 @@ def load_node(
         if node.add_event(ev):
             new_ids.append(ev.id)
     node.consensus_pass(new_ids)
+    if node._tpu_engine is not None:
+        # a backend='tpu' node with a lazy-batch threshold must still come
+        # back fully computed — the restore contract is bit-identical state
+        node._tpu_engine.flush()
     return node

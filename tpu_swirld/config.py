@@ -44,6 +44,9 @@ class SwirldConfig:
     max_rounds: int = 256
     max_orphans: int = 4096      # unknown-parent events parked per node
     max_want_rounds: int = 32    # want-list round-trips per sync
+    tpu_min_batch: int = 1       # backend='tpu': min new events per device
+                                 # pass (higher amortizes the batch replay;
+                                 # consensus output is identical, delayed)
 
     def stakes(self) -> Tuple[int, ...]:
         if self.stake is not None:

@@ -271,10 +271,17 @@ def run_with_divergent_forkers(
     n_turns: int,
     seed: int = 0,
     fork_every: int = 3,
+    node_config: Optional[Callable[[int, SwirldConfig], SwirldConfig]] = None,
+    on_turn: Optional[Callable[[int, List[Node]], None]] = None,
 ) -> DivergentSimulation:
     """Config-4 adversary model: ``n_forkers`` equivocating members serving
     divergent branches; honest nodes must stay live and prefix-consistent
-    (within the BFT bound ``n > 3f``)."""
+    (within the BFT bound ``n > 3f``).
+
+    ``node_config(i, base)`` may override an honest member's config (e.g.
+    switch one node to ``backend="tpu"``); ``on_turn(turn, honest_nodes)``
+    runs after every gossip turn (checkpoint hooks, assertions, ...).
+    """
     config = SwirldConfig(n_members=n_nodes, seed=seed)
     rng = random.Random(seed)
     keys = [crypto.keypair(b"member-%d-%d" % (seed, i)) for i in range(n_nodes)]
@@ -294,9 +301,10 @@ def run_with_divergent_forkers(
             network_want[pk] = f.ask_events
             forkers.append(f)
         else:
+            cfg_i = node_config(i, config) if node_config else config
             node = Node(
                 sk=sk, pk=pk, network=network, members=members,
-                config=config, clock=lambda: clock[0],
+                config=cfg_i, clock=lambda: clock[0],
                 network_want=network_want,
             )
             network[pk] = node.ask_sync
@@ -313,6 +321,8 @@ def run_with_divergent_forkers(
         if turn % fork_every == 0:
             for f in forkers:
                 f.step(honest_pks)
+        if on_turn is not None:
+            on_turn(turn, honest)
     return DivergentSimulation(
         config=config, nodes=honest, forkers=forkers, network=network,
         rng=rng, clock=clock, members=members,
