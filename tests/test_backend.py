@@ -6,7 +6,6 @@ import dataclasses
 import jax
 import pytest
 
-from tpu_swirld.config import SwirldConfig
 from tpu_swirld.sim import make_simulation
 
 

@@ -3,11 +3,10 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from tpu_swirld.packing import pack_node
 from tpu_swirld.sim import make_simulation, run_with_forkers
-from tpu_swirld.tpu.pallas_kernels import make_ssm_fn, ssm_matrix_pallas
+from tpu_swirld.tpu.pallas_kernels import ssm_matrix_pallas
 from tpu_swirld.tpu.pipeline import (
     ancestry, forkseen_matrix, sees_matrix, ssm_matrix,
 )

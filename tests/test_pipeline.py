@@ -5,7 +5,6 @@ consensus order.  Each test packs a seeded oracle sim and compares every
 output, no tolerance.
 """
 
-import numpy as np
 import pytest
 
 from tpu_swirld.packing import pack_node

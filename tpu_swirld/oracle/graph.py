@@ -8,7 +8,7 @@ the order the successor function yields them.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Iterable, Iterator, List, TypeVar
+from typing import Callable, Iterable, Iterator, List, TypeVar
 
 T = TypeVar("T")
 
