@@ -241,3 +241,20 @@ def test_pipeline_trivial_dags():
     assert list(result.round) == [0, 0, 0, 0]
     assert result.is_witness.all()
     assert result.order == []
+
+
+def test_parity_small_coin_period():
+    """coin_period=2 makes every even vote distance a coin round, so the
+    signature coin-bit override constantly feeds the tallies — pinning the
+    coin-vote path's parity (rarely reached with the default C=6)."""
+    from tpu_swirld.config import SwirldConfig
+
+    for seed in (6, 13):
+        cfg = SwirldConfig(n_members=5, coin_period=2, seed=seed)
+        sim = make_simulation(5, seed=seed, config=cfg)
+        sim.run(350)
+        node = sim.nodes[0]
+        packed = pack_node(node)
+        result = run_consensus(packed, node.config, block=64)
+        assert_parity(node, packed, result)
+        assert len(node.consensus) > 0
