@@ -1,14 +1,19 @@
 """Benchmark: events/sec to consensus-order, TPU pipeline vs CPU oracle.
 
 Driver contract: print ONE JSON line on stdout:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "phases": {...}}
 value       = device-pipeline consensus throughput (events/sec)
 vs_baseline = speedup over the pure-Python oracle on the same machine
               (BASELINE.json north star: >= 50x on 64 members / 10k events).
+phases      = per-phase wall-clock seconds (tpu_swirld.obs spans):
+              gossip_gen / oracle / pack / pipeline_first (incl. compile) /
+              pipeline (steady), so the headline has per-stage attribution.
 
 All detail goes to stderr.  Environment knobs:
     BENCH_MEMBERS (64)  BENCH_EVENTS (10000)  BENCH_ORACLE_EVENTS (10000)
     BENCH_TPU_PROBE_TIMEOUT (240 s)  BENCH_FORCE_CPU (unset)
+    BENCH_TRACE (unset) — write the full span trace + gauge snapshot to
+    this path (JSONL; render with `python -m tpu_swirld.obs report`).
 
 The machine's sitecustomize registers an 'axon' TPU-tunnel PJRT platform
 whose initialization has been observed to hang indefinitely; we therefore
@@ -68,16 +73,28 @@ def main():
     platform = jax.devices()[0].platform
     log(f"[env] platform={platform} devices={len(jax.devices())}")
 
+    from tpu_swirld import obs as obslib
+    from tpu_swirld.metrics import Metrics
     from tpu_swirld.oracle.node import Node
     from tpu_swirld.packing import pack_events
     from tpu_swirld.sim import generate_gossip_dag
     from tpu_swirld.tpu.pipeline import run_consensus
 
+    # one Obs for the whole bench: depth-0 spans become the published
+    # "phases" breakdown; the warm-up pipeline run executes with the Obs
+    # ambient so stage/compile attribution and pad-waste gauges land in the
+    # registry.  The steady (headline) run is spanned but NOT ambient —
+    # per-stage sync would perturb the number being published.
+    o = obslib.Obs()
+
     n_events = EVENTS if tpu_ok else min(EVENTS, 10000)
     if n_events != EVENTS:
         log(f"[env] CPU fallback: clamping BENCH_EVENTS {EVENTS} -> {n_events}")
     t0 = time.time()
-    members, stake, events, keys = generate_gossip_dag(MEMBERS, n_events, seed=1)
+    with o.tracer.span("gossip_gen"):
+        members, stake, events, keys = generate_gossip_dag(
+            MEMBERS, n_events, seed=1
+        )
     log(f"[gen] {MEMBERS} members / {n_events} events in {time.time()-t0:.1f}s")
 
     # ---- CPU oracle denominator (batch consensus pass over a prefix) ----
@@ -87,10 +104,10 @@ def main():
         clock=lambda: 0, create_genesis=False,
     )
     new_ids = [ev.id for ev in events[:n_oracle] if node.add_event(ev)]
+    node.metrics = Metrics(registry=o.registry)   # per-phase oracle seconds
     t0 = time.time()
-    node.divide_rounds(new_ids)
-    node.decide_fame()
-    node.find_order()
+    with o.tracer.span("oracle"):
+        node.consensus_pass(new_ids)
     t_oracle = time.time() - t0
     oracle_evps = n_oracle / t_oracle
     log(f"[oracle] {n_oracle} events in {t_oracle:.2f}s = {oracle_evps:.0f} ev/s "
@@ -98,8 +115,9 @@ def main():
 
     # ---- device pipeline (full DAG), parity-checked on the oracle prefix --
     t0 = time.time()
-    packed_prefix = pack_events(events[:n_oracle], members, stake)
-    packed_full = pack_events(events, members, stake)
+    with o.tracer.span("pack"):
+        packed_prefix = pack_events(events[:n_oracle], members, stake)
+        packed_full = pack_events(events, members, stake)
     log(f"[pack] {time.time()-t0:.2f}s")
 
     if n_oracle == n_events:
@@ -115,14 +133,25 @@ def main():
     log(f"[parity] prefix ({n_oracle} ev) order+rounds identical: {parity}")
 
     t0 = time.time()
-    res = run_consensus(packed_full, node.config)
+    with obslib.enabled(o):           # stage spans + compile attribution
+        with o.tracer.span("pipeline_first"):
+            res = run_consensus(packed_full, node.config)
     t_compile_and_run = time.time() - t0
     t0 = time.time()
-    res = run_consensus(packed_full, node.config)
+    with o.tracer.span("pipeline"):   # wall-clock only: no per-stage sync
+        res = run_consensus(packed_full, node.config)
     t_steady = time.time() - t0
     pipe_evps = n_events / t_steady
     log(f"[pipeline] first {t_compile_and_run:.2f}s, steady {t_steady:.2f}s = "
         f"{pipe_evps:.0f} ev/s (ordered {len(res.order)}, max_round {res.max_round})")
+
+    phases = {k: round(v, 4) for k, v in o.tracer.phase_seconds().items()}
+    log(f"[phases] {json.dumps(phases)}")
+    trace_path = os.environ.get("BENCH_TRACE")
+    if trace_path:
+        o.save(trace_path)
+        log(f"[trace] wrote {trace_path} "
+            f"(render: python -m tpu_swirld.obs report {trace_path})")
 
     speedup = pipe_evps / oracle_evps
     out = {
@@ -133,6 +162,7 @@ def main():
         "value": round(pipe_evps, 1),
         "unit": "events/s",
         "vs_baseline": round(speedup, 2),
+        "phases": phases,
     }
     print(json.dumps(out), flush=True)
     if not parity:

@@ -46,7 +46,8 @@ def test_coin_bit_in_range():
 
 
 def test_sim_crypto_backend_roundtrip():
-    crypto.set_backend("sim")
+    prev = crypto.backend_name()       # restore whatever the env gave us
+    crypto.set_backend("sim")          # (ed25519 needs `cryptography`)
     try:
         pk, sk = crypto.keypair(b"s")
         sig = crypto.sign(b"body", sk)
@@ -54,7 +55,7 @@ def test_sim_crypto_backend_roundtrip():
         assert crypto.verify(b"body", sig, pk)
         assert not crypto.verify(b"other", sig, pk)
     finally:
-        crypto.set_backend("ed25519")
+        crypto.set_backend(prev)
 
 
 def test_crypto_randrange_bounds():

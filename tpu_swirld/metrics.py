@@ -1,10 +1,17 @@
-"""Metrics / observability (SURVEY.md §5).
+"""Metrics compatibility shim over :mod:`tpu_swirld.obs` (SURVEY.md §5).
 
-Lightweight per-phase wall-clock counters plus the protocol-level gauges
-the driver metric is built from: events ingested, events ordered
-(events-to-consensus), decided-round lag, and undecided-witness backlog.
+The real observability subsystem lives in :mod:`tpu_swirld.obs` (nested-span
+tracer, counter/gauge/histogram registry, Prometheus/JSON exporters, report
+CLI).  This module keeps the original lightweight surface — ``Metrics`` with
+``phase`` / ``count`` / ``snapshot``, :func:`node_gauges`,
+:func:`trace_consensus` — as a thin shim so existing call sites keep working
+unchanged; a ``Metrics`` now records into an :class:`~tpu_swirld.obs.
+registry.Registry` (own or shared), so per-node counters and the ambient
+pipeline metrics can export through one Prometheus/JSON pipe.
+
 Zero overhead when disabled (the default); enable per node with
-``node.metrics = Metrics()`` or pass ``metrics=`` to the engine helpers.
+``node.metrics = Metrics()`` or pass ``metrics=`` / ``tracer=`` to the
+:mod:`tpu_swirld.sim` helpers.
 
 ``jax.profiler`` traces for the device pipeline are one call away:
 :func:`trace_consensus` wraps a pipeline run in a profiler trace directory
@@ -15,15 +22,23 @@ from __future__ import annotations
 
 import contextlib
 import time
-from typing import Dict
+from typing import Dict, Optional
+
+from tpu_swirld.obs.registry import Counter, Registry
+
+PHASE_METRIC = "phase_seconds"
 
 
 class Metrics:
-    """Cumulative phase timers + counters."""
+    """Cumulative phase timers + counters (registry-backed shim).
 
-    def __init__(self) -> None:
-        self.seconds: Dict[str, float] = {}
-        self.counts: Dict[str, int] = {}
+    ``seconds`` / ``counts`` remain available as dict views derived from
+    the registry, so pre-obs consumers (and ``tests/test_aux.py``) see the
+    original shape.
+    """
+
+    def __init__(self, registry: Optional[Registry] = None) -> None:
+        self.registry = registry if registry is not None else Registry()
 
     @contextlib.contextmanager
     def phase(self, name: str):
@@ -31,39 +46,87 @@ class Metrics:
         try:
             yield
         finally:
-            self.seconds[name] = self.seconds.get(name, 0.0) + (
+            self.registry.counter(PHASE_METRIC, {"phase": name}).inc(
                 time.perf_counter() - t0
             )
 
     def count(self, name: str, delta: int = 1) -> None:
-        self.counts[name] = self.counts.get(name, 0) + delta
+        # the pre-obs surface accepted any delta (plain dict addition);
+        # keep that contract — bypass Counter.inc's monotonic guard
+        self.registry.counter(name).value += delta
+
+    @property
+    def seconds(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for labels, m in self.registry.collect(PHASE_METRIC).items():
+            d = dict(labels)
+            if "phase" in d:            # ignore non-phase variants
+                out[d["phase"]] = m.value
+        return out
+
+    @property
+    def counts(self) -> Dict[str, int]:
+        return {
+            m.name: int(m.value)
+            for m in self.registry.metrics()
+            if isinstance(m, Counter)
+            and not m.labels
+            and m.name != PHASE_METRIC
+        }
 
     def snapshot(self) -> Dict[str, float]:
+        seconds = self.seconds
+        counts = self.counts
         out: Dict[str, float] = {}
-        out.update({f"s_{k}": round(v, 6) for k, v in self.seconds.items()})
-        out.update({f"n_{k}": v for k, v in self.counts.items()})
+        out.update({f"s_{k}": round(v, 6) for k, v in seconds.items()})
+        out.update({f"n_{k}": v for k, v in counts.items()})
         total = sum(
-            self.seconds.get(k, 0.0)
+            seconds.get(k, 0.0)
             for k in ("divide_rounds", "decide_fame", "find_order")
         )
-        ordered = self.counts.get("events_ordered", 0)
+        ordered = counts.get("events_ordered", 0)
         if total > 0 and ordered:
             out["events_per_sec_to_consensus"] = round(ordered / total, 2)
         return out
 
 
-def node_gauges(node) -> Dict[str, int]:
-    """Protocol-level gauges for one oracle node."""
-    undecided = sum(1 for f in node.famous.values() if f is None)
-    return {
-        "events": len(node.hg),
-        "events_ordered": len(node.consensus),
-        "max_round": node.max_round,
-        "decided_round_lag": node.max_round - node.consensus_round,
+def node_gauges(
+    node,
+    registry: Optional[Registry] = None,
+    node_label: Optional[str] = None,
+) -> Dict[str, int]:
+    """Protocol-level gauges for one oracle node.
+
+    Robust to partially-shaped nodes (checkpoint-restored or backend-engine
+    nodes may lack optional attributes): every read goes through the public
+    surface (``node.orphans_parked`` / ``node.forks_detected``) or a
+    ``getattr`` default.  With ``registry=``, each gauge is also recorded
+    as ``node_<name>{node=...}`` — labelled by ``node_label`` (default: the
+    node's pk prefix) so exporting a whole population into one shared
+    registry keeps every node distinct.
+    """
+    famous = getattr(node, "famous", {})
+    undecided = sum(1 for f in famous.values() if f is None)
+    max_round = getattr(node, "max_round", 0)
+    gauges = {
+        "events": len(getattr(node, "hg", ())),
+        "events_ordered": len(getattr(node, "consensus", ())),
+        "max_round": max_round,
+        "decided_round_lag": max_round - getattr(node, "consensus_round", 0),
         "undecided_witnesses": undecided,
-        "orphans_parked": len(node._orphans),
-        "ancient_quarantined": len(node.ancient),
+        "orphans_parked": getattr(node, "orphans_parked", 0),
+        "ancient_quarantined": len(getattr(node, "ancient", ())),
+        "forks_detected": getattr(node, "forks_detected", 0),
+        "bad_replies": getattr(node, "bad_replies", 0),
     }
+    if registry is not None:
+        if node_label is None:
+            pk = getattr(node, "pk", None)
+            node_label = pk[:4].hex() if isinstance(pk, bytes) else None
+        labels = {"node": node_label} if node_label is not None else None
+        for k, v in gauges.items():
+            registry.gauge(f"node_{k}", labels).set(v)
+    return gauges
 
 
 def trace_consensus(packed, config=None, outdir: str = "/tmp/swirld-trace", **kw):

@@ -1,0 +1,196 @@
+"""Observability subsystem: structured spans + protocol gauges (SURVEY §5).
+
+SURVEY §5 lists metrics/telemetry among the aux subsystems the reference
+never had ("no logging, no metrics, no persistence — state dies with the
+process"); this package is the real implementation the ad-hoc
+``tpu_swirld.metrics`` counters grew into.  Three pieces:
+
+- :mod:`tpu_swirld.obs.tracer` — a nested-span tracer with wall-clock +
+  monotonic timestamps and JSONL export in Chrome trace-event form
+  (``chrome://tracing`` / Perfetto compatible after ``[...]`` wrapping).
+- :mod:`tpu_swirld.obs.registry` — counters / gauges / histograms with
+  Prometheus-text and JSON exporters.
+- :mod:`tpu_swirld.obs.report` — the ``python -m tpu_swirld.obs report``
+  CLI rendering a phase-breakdown table + protocol gauges from a trace.
+
+Instrumented layers: oracle phases (``oracle/node.py::consensus_pass``),
+gossip (sync round-trips / payload bytes / events-per-sync / fork
+detections), the device pipeline stages (``tpu/pipeline.py`` — per-stage
+compile-vs-execute time, pad waste, strongly-sees column and chunk-scan
+counts), and the mesh path (``parallel.py``).  For device-internal
+profiling beyond stage granularity use ``metrics.trace_consensus`` (XProf).
+
+Enabling
+--------
+
+Everything is **disabled by default with near-zero overhead**: the hot
+paths check a module global (``obs.current() is None``) and touch neither
+tracer nor registry when it is unset.  Enable around a region::
+
+    from tpu_swirld import obs
+
+    with obs.enabled() as o:                 # or o = obs.enable()
+        run_consensus(packed, config)
+    o.save("/tmp/swirld.trace.jsonl")        # spans + registry snapshot
+    print(o.registry.to_prometheus_text())
+
+then render with ``python -m tpu_swirld.obs report /tmp/swirld.trace.jsonl``.
+
+Per-node oracle counters remain opt-in via ``node.metrics = Metrics()``
+(now a thin shim over :class:`Registry`) and ``node.tracer = Tracer()``;
+``sim.make_simulation(..., metrics=..., tracer=...)`` wires whole
+simulations.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+from tpu_swirld.obs.registry import (  # noqa: F401
+    Counter, Gauge, Histogram, Registry,
+)
+from tpu_swirld.obs.tracer import (  # noqa: F401
+    NULL_TRACER, NullTracer, Tracer, load_trace,
+)
+
+
+class Obs:
+    """A tracer + registry bundle — the unit ``enable()`` installs."""
+
+    def __init__(
+        self,
+        tracer: Optional[Tracer] = None,
+        registry: Optional[Registry] = None,
+    ):
+        self.tracer = tracer if tracer is not None else Tracer()
+        self.registry = registry if registry is not None else Registry()
+
+    def save(self, path: str) -> None:
+        """Write the trace plus the registry snapshot (as Chrome counter
+        samples) so one file carries both timing and gauges.  The tracer
+        itself is not mutated — repeated saves snapshot fresh values
+        instead of accumulating stale duplicates."""
+        import json as _json
+
+        from tpu_swirld.obs.registry import Histogram as _H, _num
+
+        events = list(self.tracer.events)
+        for m in self.registry.metrics():
+            labels = {k: v for k, v in m.labels}
+            if isinstance(m, _H):
+                events.append(
+                    self.tracer.counter_event(
+                        m.name + "_count", m.count, labels
+                    )
+                )
+                events.append(
+                    self.tracer.counter_event(
+                        m.name + "_sum", round(m.sum, 9), labels
+                    )
+                )
+            else:
+                events.append(
+                    self.tracer.counter_event(m.name, _num(m.value), labels)
+                )
+        with open(path, "w") as f:
+            for e in events:
+                f.write(_json.dumps(e) + "\n")
+
+
+_current: Optional[Obs] = None
+
+
+def current() -> Optional[Obs]:
+    """The ambient Obs, or None when observability is disabled (default).
+
+    Hot paths gate on this: ``o = obs.current(); if o is not None: ...`` —
+    one global read on the disabled path, nothing else.
+    """
+    return _current
+
+
+def enable(obs: Optional[Obs] = None) -> Obs:
+    """Install (and return) the ambient Obs."""
+    global _current
+    _current = obs if obs is not None else Obs()
+    return _current
+
+
+def disable() -> Optional[Obs]:
+    """Clear the ambient Obs; returns the one that was active."""
+    global _current
+    prev, _current = _current, None
+    return prev
+
+
+@contextlib.contextmanager
+def enabled(obs: Optional[Obs] = None):
+    """Scoped enable: ``with obs.enabled() as o: ...`` (restores the
+    previous ambient Obs on exit, so scopes nest)."""
+    global _current
+    prev = _current
+    o = obs if obs is not None else Obs()
+    _current = o
+    try:
+        yield o
+    finally:
+        _current = prev
+
+
+@contextlib.contextmanager
+def phase_scope(metrics, tracer, name: str):
+    """Combined per-phase scope: times into ``metrics`` (a
+    :class:`tpu_swirld.metrics.Metrics`) and/or spans into ``tracer``,
+    either of which may be None.  The all-None case is never constructed
+    by callers (they branch first), but stays correct."""
+    if tracer is not None and metrics is not None:
+        with tracer.span(name), metrics.phase(name):
+            yield
+    elif tracer is not None:
+        with tracer.span(name):
+            yield
+    elif metrics is not None:
+        with metrics.phase(name):
+            yield
+    else:
+        yield
+
+
+def stage_call(name: str, fn, *args, **kw):
+    """Run a jitted stage under the ambient Obs (no-op pass-through when
+    disabled): spans the call, blocks on the result so the span measures
+    device completion, and classifies the call as ``compile`` vs
+    ``execute`` by watching the jit cache grow.
+
+    Enabling observability therefore synchronizes stage boundaries —
+    that's the point (per-stage attribution); leave it disabled for
+    maximum-overlap production runs.
+    """
+    o = current()
+    if o is None:
+        return fn(*args, **kw)
+    import jax
+
+    c0 = _jit_cache_size(fn)
+    t0 = time.perf_counter()
+    with o.tracer.span(name) as sp:
+        out = fn(*args, **kw)
+        out = jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        kind = "execute"
+        if c0 >= 0 and _jit_cache_size(fn) > c0:
+            kind = "compile"
+        sp.args["kind"] = kind   # inside the span: lands in the event
+    reg = o.registry
+    reg.counter("pipeline_stage_seconds", {"stage": name, "kind": kind}).inc(dt)
+    reg.counter("pipeline_stage_calls", {"stage": name, "kind": kind}).inc()
+    return out
+
+
+def _jit_cache_size(fn) -> int:
+    try:
+        return fn._cache_size()
+    except Exception:
+        return -1

@@ -1,0 +1,5 @@
+import sys
+
+from tpu_swirld.obs.report import main
+
+sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,124 @@
+"""``python -m tpu_swirld.obs report`` — render a trace file as tables.
+
+Consumes the JSONL (or Chrome-wrapped) trace written by
+:meth:`tpu_swirld.obs.Obs.save` / :meth:`tpu_swirld.obs.tracer.Tracer.save`
+and prints:
+
+1. a *phase breakdown* — per span name: calls, total/mean/max milliseconds,
+   and percent of the total traced depth-0 time, nested names indented by
+   their recorded depth;
+2. the *protocol gauges* — every counter sample (``ph: "C"``) embedded in
+   the trace, i.e. the registry snapshot at save time.
+
+Pure stdlib + pure functions over the event list, so the CLI can be smoke-
+tested cheaply (``tests/test_obs.py``) and never rots silently.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from tpu_swirld.obs.tracer import load_trace
+
+
+def aggregate_spans(events: List[Dict]) -> List[Dict]:
+    """Group ``ph == "X"`` events by (depth, name) preserving first-seen
+    order within a depth; returns rows with calls/total/mean/max ms."""
+    rows: Dict[Tuple[int, str], Dict] = {}
+    order: List[Tuple[int, str]] = []
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        depth = int(e.get("args", {}).get("depth", 0))
+        key = (depth, e["name"])
+        row = rows.get(key)
+        if row is None:
+            row = {
+                "name": e["name"], "depth": depth, "calls": 0,
+                "total_ms": 0.0, "max_ms": 0.0,
+            }
+            rows[key] = row
+            order.append(key)
+        dur_ms = float(e.get("dur", 0.0)) / 1000.0
+        row["calls"] += 1
+        row["total_ms"] += dur_ms
+        row["max_ms"] = max(row["max_ms"], dur_ms)
+    # sort: depth-0 rows by total desc, children right after their depth
+    # cannot be reconstructed without parent links — keep stable order
+    # within depth, depth-0 first-seen order preserved.
+    out = [rows[k] for k in order]
+    for row in out:
+        row["mean_ms"] = row["total_ms"] / row["calls"]
+    return out
+
+
+def gauge_rows(events: List[Dict]) -> List[Dict]:
+    """Counter samples (``ph == "C"``): the registry snapshot lines."""
+    rows = []
+    for e in events:
+        if e.get("ph") != "C":
+            continue
+        args = dict(e.get("args", {}))
+        value = args.pop("value", None)
+        rows.append({"name": e["name"], "value": value, "labels": args})
+    return rows
+
+
+def render_report(events: List[Dict]) -> str:
+    spans = aggregate_spans(events)
+    gauges = gauge_rows(events)
+    lines: List[str] = []
+    total_top = sum(r["total_ms"] for r in spans if r["depth"] == 0)
+    lines.append("== phase breakdown ==")
+    if spans:
+        lines.append(
+            f"{'span':<44} {'calls':>6} {'total_ms':>10} {'mean_ms':>9} "
+            f"{'max_ms':>9} {'%top':>6}"
+        )
+        for r in spans:
+            name = "  " * r["depth"] + r["name"]
+            pct = (
+                f"{100.0 * r['total_ms'] / total_top:5.1f}%"
+                if r["depth"] == 0 and total_top > 0
+                else ""
+            )
+            lines.append(
+                f"{name:<44} {r['calls']:>6} {r['total_ms']:>10.3f} "
+                f"{r['mean_ms']:>9.3f} {r['max_ms']:>9.3f} {pct:>6}"
+            )
+    else:
+        lines.append("(no spans in trace)")
+    lines.append("")
+    lines.append("== protocol gauges ==")
+    if gauges:
+        width = max(len(_gauge_name(g)) for g in gauges)
+        for g in gauges:
+            lines.append(f"{_gauge_name(g):<{width}}  {g['value']}")
+    else:
+        lines.append("(no counter samples in trace)")
+    return "\n".join(lines)
+
+
+def _gauge_name(g: Dict) -> str:
+    if g["labels"]:
+        lab = ",".join(f"{k}={v}" for k, v in sorted(g["labels"].items()))
+        return f"{g['name']}{{{lab}}}"
+    return g["name"]
+
+
+def main(argv: List[str]) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu_swirld.obs",
+        description="tpu_swirld observability tooling",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    rep = sub.add_parser("report", help="render a trace file as tables")
+    rep.add_argument("trace", help="JSONL (or Chrome-wrapped) trace file")
+    args = ap.parse_args(argv)
+    if args.cmd == "report":
+        events = load_trace(args.trace)
+        print(render_report(events))
+        return 0
+    return 2

@@ -1,0 +1,231 @@
+"""Nested-span tracer with JSONL export (Chrome trace-event compatible).
+
+Each finished span becomes one JSON object — one per line in the exported
+file — using the Chrome trace-event "complete" form (``ph: "X"``)::
+
+    {"name": "oracle.divide_rounds", "ph": "X", "pid": 0, "tid": 0,
+     "ts": 12.5, "dur": 834.2, "args": {"depth": 1, "wall_s": 1754...}}
+
+``ts``/``dur`` are microseconds on the tracer's *monotonic* clock
+(``time.perf_counter`` relative to the tracer epoch — immune to wall-clock
+steps); the wall-clock start time rides in ``args.wall_s`` so traces can be
+correlated with external logs.  ``args.depth`` records the nesting level at
+emit time (Chrome infers nesting from ts/dur overlap; the report CLI uses
+the explicit depth).  A file of these lines loads directly into
+``chrome://tracing`` / Perfetto after wrapping in ``[...]`` —
+:func:`save_chrome` writes that wrapped form, :meth:`Tracer.save` the JSONL.
+
+Disabled mode: :data:`NULL_TRACER` answers every ``span()`` call with one
+shared no-op context manager — no allocation, no timestamps, nothing
+recorded — so instrumentation can unconditionally ``with tracer.span(...)``
+once it holds *a* tracer.  Call sites that may hold ``None`` instead should
+branch (``if tracer is not None``), which is the pattern the hot paths use.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Optional
+
+
+class _SpanHandle:
+    """Mutable args bag yielded by ``Tracer.span`` — mutate ``args`` inside
+    the ``with`` block to attach data to the emitted event."""
+
+    __slots__ = ("name", "args", "_t0_mono", "_wall_s")
+
+    def __init__(self, name: str, args: Dict, t0_mono: float, wall_s: float):
+        self.name = name
+        self.args = args
+        self._t0_mono = t0_mono
+        self._wall_s = wall_s
+
+
+class _NullSpan:
+    """Shared no-op context manager (also serves as a null span handle)."""
+
+    __slots__ = ()
+
+    @property
+    def args(self) -> Dict:
+        # a fresh throwaway dict per access: annotation writes vanish
+        # instead of accumulating in (or leaking through) shared state
+        return {}
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class NullTracer:
+    """Disabled tracer: every call returns the one shared no-op span."""
+
+    __slots__ = ()
+    enabled = False
+    events: List[Dict] = []
+
+    def span(self, name: str, **args):
+        return _NULL_SPAN
+
+    def instant(self, name: str, **args) -> None:
+        pass
+
+    def save(self, path: str) -> None:
+        raise RuntimeError("NullTracer records nothing; nothing to save")
+
+
+NULL_TRACER = NullTracer()
+
+
+class _SpanCtx:
+    """The live span context manager (one allocation per enabled span)."""
+
+    __slots__ = ("_tracer", "_handle")
+
+    def __init__(self, tracer: "Tracer", handle: _SpanHandle):
+        self._tracer = tracer
+        self._handle = handle
+
+    def __enter__(self) -> _SpanHandle:
+        h = self._handle
+        h._wall_s = time.time()
+        h._t0_mono = time.perf_counter()   # re-stamped at entry, not creation
+        self._tracer._stack.append(h)
+        return h
+
+    def __exit__(self, *exc):
+        t = self._tracer
+        h = t._stack.pop()
+        end = time.perf_counter()
+        t.events.append(
+            {
+                "name": h.name,
+                "ph": "X",
+                "pid": t.pid,
+                "tid": t.tid,
+                "ts": round((h._t0_mono - t._epoch_mono) * 1e6, 3),
+                "dur": round((end - h._t0_mono) * 1e6, 3),
+                "args": dict(
+                    h.args, depth=len(t._stack), wall_s=round(h._wall_s, 6)
+                ),
+            }
+        )
+        return False
+
+
+class Tracer:
+    """Collects spans + instant events; exports JSONL / Chrome traces."""
+
+    enabled = True
+
+    def __init__(self, pid: int = 0, tid: int = 0):
+        self.pid = pid
+        self.tid = tid
+        self.events: List[Dict] = []
+        self._stack: List[_SpanHandle] = []
+        self._epoch_mono = time.perf_counter()
+        self._epoch_wall = time.time()
+
+    # ------------------------------------------------------------ recording
+
+    def span(self, name: str, **args) -> _SpanCtx:
+        """Context manager timing a nested span.  Yields a handle whose
+        ``.args`` dict can be mutated to annotate the emitted event."""
+        return _SpanCtx(
+            self, _SpanHandle(name, args, time.perf_counter(), time.time())
+        )
+
+    def instant(self, name: str, **args) -> None:
+        """A zero-duration marker event (Chrome ``ph: "i"``)."""
+        self.events.append(
+            {
+                "name": name,
+                "ph": "i",
+                "pid": self.pid,
+                "tid": self.tid,
+                "ts": round((time.perf_counter() - self._epoch_mono) * 1e6, 3),
+                "s": "t",
+                "args": dict(args, depth=len(self._stack)),
+            }
+        )
+
+    def counter_event(
+        self, name: str, value: float, labels: Optional[Dict] = None
+    ) -> Dict:
+        """Build (without recording) a Chrome counter sample (``ph: "C"``)
+        — ``Obs.save`` uses these to embed the registry snapshot in the
+        trace file without mutating the tracer."""
+        args: Dict = {}
+        for k, v in (labels or {}).items():
+            # "value" is reserved for the sample itself; don't conflate
+            args["label_value" if k == "value" else k] = v
+        args["value"] = value
+        return {
+            "name": name,
+            "ph": "C",
+            "pid": self.pid,
+            "ts": round((time.perf_counter() - self._epoch_mono) * 1e6, 3),
+            "args": args,
+        }
+
+    def counter(
+        self, name: str, value: float, labels: Optional[Dict] = None
+    ) -> None:
+        """Record a Chrome counter sample."""
+        self.events.append(self.counter_event(name, value, labels))
+
+    @property
+    def depth(self) -> int:
+        return len(self._stack)
+
+    # -------------------------------------------------------------- queries
+
+    def spans(self) -> List[Dict]:
+        return [e for e in self.events if e.get("ph") == "X"]
+
+    def phase_seconds(self, depth: int = 0) -> Dict[str, float]:
+        """Total seconds per span name at one nesting depth — the
+        phase-breakdown aggregation bench.py publishes."""
+        out: Dict[str, float] = {}
+        for e in self.spans():
+            if e["args"].get("depth") == depth:
+                out[e["name"]] = out.get(e["name"], 0.0) + e["dur"] / 1e6
+        return out
+
+    # ------------------------------------------------------------ export/io
+
+    def save(self, path: str) -> None:
+        """JSONL: one Chrome trace event per line."""
+        with open(path, "w") as f:
+            for e in self.events:
+                f.write(json.dumps(e) + "\n")
+
+    def save_chrome(self, path: str) -> None:
+        """The ``{"traceEvents": [...]}`` wrapped form chrome://tracing and
+        Perfetto open directly."""
+        with open(path, "w") as f:
+            json.dump({"traceEvents": self.events}, f)
+
+
+def load_trace(path: str) -> List[Dict]:
+    """Read a trace written by :meth:`Tracer.save` (JSONL) or
+    :meth:`Tracer.save_chrome` (wrapped JSON) back into an event list."""
+    with open(path) as f:
+        text = f.read()
+    stripped = text.lstrip()
+    if stripped.startswith("{") and '"traceEvents"' in stripped[:200]:
+        return json.loads(stripped)["traceEvents"]
+    if stripped.startswith("["):
+        return json.loads(stripped)
+    events = []
+    for line in text.splitlines():
+        line = line.strip()
+        if line:
+            events.append(json.loads(line))
+    return events

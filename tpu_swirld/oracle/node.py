@@ -59,6 +59,7 @@ from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 from tpu_swirld import crypto
 from tpu_swirld.config import SwirldConfig
+from tpu_swirld.obs import phase_scope
 from tpu_swirld.oracle.event import Event, decode_event, encode_event
 from tpu_swirld.oracle.graph import toposort
 
@@ -95,6 +96,7 @@ class Node:
         self._orphans: Dict[bytes, Event] = {}
         self.bad_replies = 0  # malformed/mis-signed replies tolerated so far
         self.metrics = None   # set to metrics.Metrics() to enable counters
+        self.tracer = None    # set to obs.Tracer() to record phase spans
         self._tpu_engine = None   # lazily built when config.backend == "tpu"
         self.members: List[bytes] = list(members)
         self.member_index: Dict[bytes, int] = {m: i for i, m in enumerate(members)}
@@ -151,6 +153,16 @@ class Node:
 
     def _lamport_clock(self) -> int:
         return len(self.order_added)
+
+    @property
+    def orphans_parked(self) -> int:
+        """Events parked awaiting missing parents (public gauge surface)."""
+        return len(self._orphans)
+
+    @property
+    def forks_detected(self) -> int:
+        """Members this node has seen fork (public gauge surface)."""
+        return sum(1 for v in self.has_fork.values() if v)
 
     def _now(self) -> int:
         t = int(self._clock())
@@ -227,6 +239,8 @@ class Node:
             # first fork at this (creator, seq)
             self.fork_groups[c][s] = group
             self.has_fork[c] = True
+            if self.metrics is not None:
+                self.metrics.count("gossip_fork_pairs_detected")
         if not self.has_fork[c]:
             self.member_chain[c].append(eid)   # index == seq while honest
         if c == self.pk:
@@ -480,6 +494,10 @@ class Node:
         )
         req = hv + crypto.sign(hv, self.sk, crypto.DOMAIN_SYNC_REQ)
         new_ids: List[bytes] = []
+        met = self.metrics
+        if met is not None:
+            met.count("gossip_syncs")
+            met.count("gossip_bytes_out", len(req))
         try:
             reply = self.network[peer_pk](self.pk, req)
             events = self._decode_signed_blob(reply, peer_pk)
@@ -487,7 +505,11 @@ class Node:
             # bad signature or malformed blob: a byzantine peer must not be
             # able to kill our gossip loop — treat as a failed gossip round
             self.bad_replies += 1
+            if met is not None:
+                met.count("gossip_bad_replies")
             return new_ids
+        if met is not None:
+            met.count("gossip_bytes_in", len(reply))
         self._ingest(events, new_ids)
         # want-list recovery: bounded by DAG depth, capped defensively
         ask = self.network_want.get(peer_pk)
@@ -497,17 +519,27 @@ class Node:
                 break
             wv = b"".join(want)
             wreq = wv + crypto.sign(wv, self.sk, crypto.DOMAIN_WANT)
+            if met is not None:
+                met.count("gossip_want_roundtrips")
+                met.count("gossip_bytes_out", len(wreq))
             try:
-                got = self._decode_signed_blob(ask(self.pk, wreq), peer_pk)
+                wreply = ask(self.pk, wreq)
+                got = self._decode_signed_blob(wreply, peer_pk)
             except ValueError:
                 self.bad_replies += 1
+                if met is not None:
+                    met.count("gossip_bad_replies")
                 break
+            if met is not None:
+                met.count("gossip_bytes_in", len(wreply))
             if not got:
                 break
             before = len(new_ids) + len(self._orphans)
             self._ingest(got, new_ids)
             if len(new_ids) + len(self._orphans) == before:
                 break   # no progress: stop asking this peer
+        if met is not None:
+            met.count("gossip_events_received", len(new_ids))
         return new_ids
 
     def sync(self, peer_pk: bytes, payload: bytes) -> List[bytes]:
@@ -727,31 +759,33 @@ class Node:
                 from tpu_swirld.backend import TpuEngine
 
                 self._tpu_engine = TpuEngine(self)
-            if self.metrics is None:
+            if self.metrics is None and self.tracer is None:
                 self._tpu_engine.consensus_pass(new_ids)
             else:
-                before = len(self.consensus)
-                with self.metrics.phase("tpu_pipeline"):
+                before = len(self.consensus) if self.metrics is not None else 0
+                with phase_scope(self.metrics, self.tracer, "tpu_pipeline"):
                     self._tpu_engine.consensus_pass(new_ids)
-                self.metrics.count("events_processed", len(new_ids))
-                self.metrics.count(
-                    "events_ordered", len(self.consensus) - before
-                )
+                if self.metrics is not None:
+                    self.metrics.count("events_processed", len(new_ids))
+                    self.metrics.count(
+                        "events_ordered", len(self.consensus) - before
+                    )
             return
-        if self.metrics is None:
+        if self.metrics is None and self.tracer is None:
             self.divide_rounds(new_ids)
             self.decide_fame()
             self.find_order()
             return
-        before = len(self.consensus)
-        with self.metrics.phase("divide_rounds"):
+        before = len(self.consensus) if self.metrics is not None else 0
+        with phase_scope(self.metrics, self.tracer, "divide_rounds"):
             self.divide_rounds(new_ids)
-        with self.metrics.phase("decide_fame"):
+        with phase_scope(self.metrics, self.tracer, "decide_fame"):
             self.decide_fame()
-        with self.metrics.phase("find_order"):
+        with phase_scope(self.metrics, self.tracer, "find_order"):
             self.find_order()
-        self.metrics.count("events_processed", len(new_ids))
-        self.metrics.count("events_ordered", len(self.consensus) - before)
+        if self.metrics is not None:
+            self.metrics.count("events_processed", len(new_ids))
+            self.metrics.count("events_ordered", len(self.consensus) - before)
 
     def main(self, pick_peer: Callable[[], bytes], payload_fn=None):
         """Coroutine: each resume gossips with one random peer and runs a

@@ -32,7 +32,13 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tpu_swirld import obs
 from tpu_swirld.tpu.pipeline import _bmm, consensus_body
+
+try:                                   # moved out of experimental in new JAX
+    _shard_map = jax.shard_map
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _shard_map
 
 MEMBER_AXIS = "members"
 
@@ -53,6 +59,8 @@ def make_mesh(n_devices: Optional[int] = None) -> Mesh:
     devs = jax.devices()
     if n_devices is not None:
         devs = devs[:n_devices]
+    # (the mesh_devices gauge is recorded per run by run_consensus, the
+    # point where an ambient Obs is reliably in scope)
     return Mesh(np.array(devs), (MEMBER_AXIS,))
 
 
@@ -64,7 +72,7 @@ def ssm_matrix_sharded(sees, member_table, stake, tot_stake, dtype, *, mesh):
     """
 
     @functools.partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=(P(None, None), P(MEMBER_AXIS, None), P(MEMBER_AXIS)),
         out_specs=P(None, None),
@@ -83,9 +91,11 @@ def ssm_matrix_sharded(sees, member_table, stake, tot_stake, dtype, *, mesh):
 
         # the per-device partial tally varies over the member axis; mark the
         # initial carry as varying so the fori_loop carry types line up
-        acc0 = lax.pcast(
-            jnp.zeros((n, n), dtype=jnp.int32), (MEMBER_AXIS,), to="varying"
-        )
+        # (pcast only exists once varying-type checking does — older
+        # shard_map accepts the plain carry)
+        acc0 = jnp.zeros((n, n), dtype=jnp.int32)
+        if hasattr(lax, "pcast"):
+            acc0 = lax.pcast(acc0, (MEMBER_AXIS,), to="varying")
         acc = lax.fori_loop(0, mt.shape[0], body, acc0)
         acc = lax.psum(acc, MEMBER_AXIS)
         return 3 * acc > 2 * tot_stake
@@ -116,6 +126,9 @@ def pad_members(member_table: np.ndarray, stake: np.ndarray, n_devices: int):
     """Pad the member axis to a multiple of the mesh size (-1 rows, 0 stake)."""
     m = member_table.shape[0]
     m_pad = ((m + n_devices - 1) // n_devices) * n_devices
+    o = obs.current()
+    if o is not None:
+        o.registry.gauge("mesh_member_pad").set(m_pad - m)
     if m_pad == m:
         return member_table, stake
     extra = m_pad - m

@@ -15,8 +15,25 @@ from typing import Callable, Dict, List, Optional
 
 from tpu_swirld import crypto
 from tpu_swirld.config import SwirldConfig
+from tpu_swirld.metrics import Metrics
 from tpu_swirld.oracle.event import Event
 from tpu_swirld.oracle.node import Node
+
+
+def attach_obs(node: Node, metrics=None, tracer=None) -> None:
+    """Wire observability into one node.
+
+    ``metrics``: a shared :class:`~tpu_swirld.metrics.Metrics` instance
+    (all nodes aggregate into one registry), ``True`` for a fresh per-node
+    ``Metrics()``, or ``None`` to leave disabled.  ``tracer``: a
+    :class:`~tpu_swirld.obs.Tracer` shared by every node it is given to
+    (spans carry no node id — pass one tracer per node for per-node
+    timelines), or ``None``.
+    """
+    if metrics:            # falsy (None/False) means disabled
+        node.metrics = Metrics() if metrics is True else metrics
+    if tracer:
+        node.tracer = tracer
 
 
 @dataclasses.dataclass
@@ -68,9 +85,17 @@ def make_simulation(
     n_nodes: int,
     seed: int = 0,
     config: Optional[SwirldConfig] = None,
+    metrics=None,
+    tracer=None,
 ) -> Simulation:
     """Build keypairs, the shared network dict, and N nodes (the reference's
-    ``test(n_nodes, n_turns)`` setup)."""
+    ``test(n_nodes, n_turns)`` setup).
+
+    ``metrics=`` / ``tracer=`` (see :func:`attach_obs`) wire gossip counters
+    and phase spans into every node at construction time — no post-hoc
+    patching.  Pass one shared ``Metrics`` to aggregate the population's
+    gossip traffic into a single registry.
+    """
     config = config or SwirldConfig(n_members=n_nodes, seed=seed)
     if config.n_members != n_nodes:
         raise ValueError("config.n_members != n_nodes")
@@ -91,6 +116,7 @@ def make_simulation(
             clock=lambda: clock[0],
             network_want=network_want,
         )
+        attach_obs(node, metrics, tracer)
         network[pk] = node.ask_sync
         network_want[pk] = node.ask_events
         nodes.append(node)
@@ -166,9 +192,13 @@ def run_with_forkers(
     n_turns: int,
     seed: int = 0,
     fork_every: int = 7,
+    metrics=None,
+    tracer=None,
 ) -> Simulation:
-    """Config-4-style run: honest gossip with periodic fork injection."""
-    sim = make_simulation(n_nodes, seed=seed)
+    """Config-4-style run: honest gossip with periodic fork injection.
+    ``metrics=`` / ``tracer=`` as in :func:`make_simulation` — fork-pair
+    detections land in ``gossip_fork_pairs_detected``."""
+    sim = make_simulation(n_nodes, seed=seed, metrics=metrics, tracer=tracer)
     adversary = ForkingAdversary(sim, list(range(n_forkers)), fork_every)
     for _ in range(n_turns):
         sim.step()
@@ -273,6 +303,8 @@ def run_with_divergent_forkers(
     fork_every: int = 3,
     node_config: Optional[Callable[[int, SwirldConfig], SwirldConfig]] = None,
     on_turn: Optional[Callable[[int, List[Node]], None]] = None,
+    metrics=None,
+    tracer=None,
 ) -> DivergentSimulation:
     """Config-4 adversary model: ``n_forkers`` equivocating members serving
     divergent branches; honest nodes must stay live and prefix-consistent
@@ -281,6 +313,8 @@ def run_with_divergent_forkers(
     ``node_config(i, base)`` may override an honest member's config (e.g.
     switch one node to ``backend="tpu"``); ``on_turn(turn, honest_nodes)``
     runs after every gossip turn (checkpoint hooks, assertions, ...).
+    ``metrics=`` / ``tracer=`` (see :func:`attach_obs`) instrument the
+    *honest* nodes — the adversary's branch nodes stay unobserved.
     """
     config = SwirldConfig(n_members=n_nodes, seed=seed)
     rng = random.Random(seed)
@@ -307,6 +341,7 @@ def run_with_divergent_forkers(
                 config=cfg_i, clock=lambda: clock[0],
                 network_want=network_want,
             )
+            attach_obs(node, metrics, tracer)
             network[pk] = node.ask_sync
             network_want[pk] = node.ask_events
             honest.append(node)

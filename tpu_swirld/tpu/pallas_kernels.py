@@ -38,6 +38,20 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# renamed TPUCompilerParams -> CompilerParams across JAX releases
+_CompilerParams = getattr(
+    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+)
+
+
+def _compiler_params(**kw):
+    if _CompilerParams is None:
+        raise RuntimeError(
+            "incompatible JAX: jax.experimental.pallas.tpu exposes neither "
+            "CompilerParams nor TPUCompilerParams"
+        )
+    return _CompilerParams(**kw)
+
 
 def _ssm_kernel(stake_ref, a_ref, b_ref, out_ref, acc_ref, *, n_members,
                 tot_stake):
@@ -128,7 +142,7 @@ def ssm_matrix_pallas(
         ),
         scratch_shapes=[pltpu.VMEM((tile_m, tile_n), jnp.int32)],
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
     )(stake.astype(jnp.int32), a, b)

@@ -47,6 +47,7 @@ threshold at 0.5.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 import math
@@ -59,12 +60,36 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from tpu_swirld import crypto
+from tpu_swirld import crypto, obs
 from tpu_swirld.config import SwirldConfig
 from tpu_swirld.oracle.node import xor_bytes
 from tpu_swirld.packing import PackedDAG
 
 INT32_MAX = np.iinfo(np.int32).max
+
+
+def _maybe_span(o, name: str, **args):
+    """A tracer span under the ambient Obs, or a no-op when disabled.
+
+    Stage-granular only — never called per event, so the disabled path
+    costs one None check per *stage*."""
+    if o is None:
+        return contextlib.nullcontext()
+    return o.tracer.span(name, **args)
+
+
+def _record_shapes(o, *, n: int, n_pad: int, statics: Dict) -> None:
+    """Pad-waste + static-shape gauges for one pipeline invocation."""
+    g = o.registry
+    g.gauge("pipeline_events").set(n)
+    g.gauge("pipeline_pad_events").set(n_pad - n)
+    g.gauge("pipeline_pad_waste_frac").set(
+        round((n_pad - n) / max(n_pad, 1), 6)
+    )
+    g.gauge("pipeline_s_max").set(statics["s_max"])
+    g.gauge("pipeline_block").set(statics["block"])
+    # pipeline_r_max is set later, once the chain-trimmed effective bound
+    # (the one the witness table actually uses) is known
 
 
 def default_matmul_dtype():
@@ -877,6 +902,11 @@ def run_consensus(
     )
     config = config or SwirldConfig(n_members=packed.n_members)
     n = packed.n
+    o = obs.current()
+    if o is not None:
+        _record_shapes(
+            o, n=n, n_pad=arrays["parents"].shape[0], statics=statics
+        )
     parents, creator, t_rank, coin = (
         arrays["parents"], arrays["creator"], arrays["t_rank"], arrays["coin"]
     )
@@ -908,10 +938,16 @@ def run_consensus(
             member_table, stake, mesh.devices.size
         )
         kernel = consensus_fn_for_mesh(mesh)
+        if o is not None:
+            o.registry.gauge("mesh_devices").set(int(mesh.devices.size))
         # max_round never exceeds the longest self-chain; bound the fused
         # kernel's witness table accordingly (same bound as the staged path)
         r_max = min(r_max, _bucket(chain + 1, 32))
-        out = kernel(
+        if o is not None:
+            o.registry.gauge("pipeline_r_max").set(r_max)
+        out = obs.stage_call(
+            "pipeline.mesh_consensus",
+            kernel,
             jnp.asarray(parents),
             jnp.asarray(creator),
             jnp.asarray(t_rank),
@@ -937,7 +973,8 @@ def run_consensus(
                 "witness table overflow: raise config.max_rounds / s_max"
             )
         t_fin0 = time.perf_counter()
-        result = finalize_order(packed, out, ts_unique)
+        with _maybe_span(o, "pipeline.finalize"):
+            result = finalize_order(packed, out, ts_unique)
         result.timings = {
             "device_and_dispatch": round(t_device, 6),
             "finalize_host": round(time.perf_counter() - t_fin0, 6),
@@ -949,6 +986,8 @@ def run_consensus(
     # rises at most once per own event), so the witness table is bounded
     # by chain+1 rounds; bucket to limit recompiles.
     r_rounds = min(r_max, _bucket(chain + 1, 32))
+    if o is not None:
+        o.registry.gauge("pipeline_r_max").set(r_rounds)
     if ssm_mode == "columns" and not use_pallas_ssm:
         return _run_consensus_columns(
             packed, config, parents, creator, t_rank, coin, stake,
@@ -962,7 +1001,9 @@ def run_consensus(
             interpret=jax.default_backend() != "tpu"
         )
     t_dev0 = time.perf_counter()
-    stage_a = stage_a_fn(
+    stage_a = obs.stage_call(
+        "pipeline.rounds_stage",
+        stage_a_fn,
         jnp.asarray(parents),
         jnp.asarray(creator),
         jnp.asarray(stake),
@@ -982,7 +1023,9 @@ def run_consensus(
         )
     max_round = int(stage_a["max_round"])     # device -> host scalar
     r_tight = min(r_rounds, _bucket(max_round + 3, 8))
-    stage_b = fame_order_stage(
+    stage_b = obs.stage_call(
+        "pipeline.fame_order_stage",
+        fame_order_stage,
         stage_a["anc"],
         stage_a["sees"],
         stage_a["ssm"],
@@ -1014,7 +1057,8 @@ def run_consensus(
     out = jax.tree.map(np.asarray, out)       # blocks on device completion
     t_device = time.perf_counter() - t_dev0
     t_fin0 = time.perf_counter()
-    result = finalize_order(packed, out, ts_unique)
+    with _maybe_span(o, "pipeline.finalize"):
+        result = finalize_order(packed, out, ts_unique)
     result.timings = {
         "device_and_dispatch": round(t_device, 6),
         "finalize_host": round(time.perf_counter() - t_fin0, 6),
@@ -1040,18 +1084,21 @@ def _run_consensus_columns(
     """
     n_pad = parents.shape[0]
     has_forks = bool(len(packed.fork_pairs))
+    o = obs.current()
     t_dev0 = time.perf_counter()
     parents_d = jnp.asarray(parents)
     creator_d = jnp.asarray(creator)
     stake_d = jnp.asarray(stake)
     mt_d = jnp.asarray(member_table)
     n_d = jnp.asarray(n, dtype=jnp.int32)
-    anc, sees = visibility_stage(
+    anc, sees = obs.stage_call(
+        "pipeline.visibility_stage",
+        visibility_stage,
         parents_d, creator_d, jnp.asarray(packed.fork_pairs),
         n_members=int(stake.shape[0]), block=block,
         matmul_dtype_name=matmul_dtype_name,
     )
-    a3, b3 = member_slabs(sees, mt_d)
+    a3, b3 = obs.stage_call("pipeline.member_slabs", member_slabs, sees, mt_d)
 
     # incremental column store: a preallocated (N, W_CAP) buffer written
     # in place so the scan's input shape stays stable (W_CAP grows in
@@ -1075,7 +1122,9 @@ def _run_consensus_columns(
             ssm_c = jnp.pad(ssm_c, ((0, 0), (0, w_cap - ssm_c.shape[1])))
         cols_arr = np.full((batch,), -1, dtype=np.int32)
         cols_arr[: len(events)] = events
-        part = ssm_cols_stage(
+        part = obs.stage_call(
+            "pipeline.ssm_cols_stage",
+            ssm_cols_stage,
             a3, b3, stake_d, jnp.asarray(cols_arr), tot_stake=tot,
             matmul_dtype_name=matmul_dtype_name,
         )
@@ -1109,7 +1158,9 @@ def _run_consensus_columns(
         # register at most chunk_size witnesses, so this bound is safe
         # even for degenerate one-round-per-event DAGs (2-member gossip)
         for _attempt in range(chunk_size + 1):
-            out = rounds_chunk_stage(
+            out = obs.stage_call(
+                "pipeline.rounds_chunk_stage",
+                rounds_chunk_stage,
                 parents_d, ssm_c, jnp.asarray(col_pos), creator_d,
                 stake_d, n_d, *state, start_d,
                 tot_stake=tot, r_max=r_rounds, s_max=s_max,
@@ -1155,7 +1206,9 @@ def _run_consensus_columns(
     max_round_d = jnp.max(jnp.where(jnp.arange(n_pad) < n_d, rnd_a, 0))
     max_round = int(max_round_d)
     r_tight = min(r_rounds, _bucket(max_round + 3, 8))
-    stage_b = fame_order_cols_stage(
+    stage_b = obs.stage_call(
+        "pipeline.fame_order_cols_stage",
+        fame_order_cols_stage,
         anc, sees, ssm_c, jnp.asarray(col_pos), tab_a, cnt_a,
         creator_d, jnp.asarray(coin), stake_d,
         jnp.asarray(parents[:, 0]), jnp.asarray(t_rank),
@@ -1175,7 +1228,11 @@ def _run_consensus_columns(
     out = jax.tree.map(np.asarray, out)
     t_device = time.perf_counter() - t_dev0
     t_fin0 = time.perf_counter()
-    result = finalize_order(packed, out, ts_unique)
+    with _maybe_span(o, "pipeline.finalize"):
+        result = finalize_order(packed, out, ts_unique)
+    if o is not None:
+        o.registry.counter("pipeline_ssm_columns_total").inc(n_cols)
+        o.registry.counter("pipeline_chunk_scans_total").inc(n_scans)
     result.timings = {
         "device_and_dispatch": round(t_device, 6),
         "finalize_host": round(time.perf_counter() - t_fin0, 6),

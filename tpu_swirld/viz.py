@@ -14,12 +14,45 @@ information dependency-free:
   witness, filled = famous) for quick ``dot -Tsvg`` inspection.
 - :func:`ascii_lanes` — a terminal sketch: one lane per member, one row
   per height, round numbers in the cells.
+- :func:`fame_gauges` — per-round decided/undecided witness-fame counts,
+  recordable into an :class:`~tpu_swirld.obs.registry.Registry` so one
+  trace file carries both the timing spans and the DAG-shape gauges.
+  ``to_dot`` / ``ascii_lanes`` annotate their output with these gauges.
 """
 
 from __future__ import annotations
 
 import json
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
+
+
+def fame_gauges(rows: List[Dict], registry=None) -> Dict[int, Tuple[int, int]]:
+    """Per-round ``(decided, undecided)`` witness-fame counts.
+
+    ``rows`` is an :func:`export_state` export.  With ``registry=`` (an
+    ``obs.Registry``), each round also lands as gauges
+    ``round_fame_decided{round=r}`` / ``round_fame_undecided{round=r}``,
+    joining the protocol gauges the report CLI renders.
+    """
+    acc: Dict[int, List[int]] = {}
+    for r in rows:
+        if not r["witness"] or r["round"] is None:
+            continue
+        cell = acc.setdefault(r["round"], [0, 0])
+        cell[0 if r["famous"] is not None else 1] += 1
+    gauges = {rnd: (d, u) for rnd, (d, u) in sorted(acc.items())}
+    if registry is not None:
+        for rnd, (d, u) in gauges.items():
+            registry.gauge("round_fame_decided", {"round": str(rnd)}).set(d)
+            registry.gauge("round_fame_undecided", {"round": str(rnd)}).set(u)
+    return gauges
+
+
+def _fame_summary(gauges: Dict[int, Tuple[int, int]], empty: str) -> str:
+    return (
+        " ".join(f"r{rnd}={d}/{d + u}" for rnd, (d, u) in gauges.items())
+        or empty
+    )
 
 
 def export_state(node=None, packed=None, result=None) -> List[Dict]:
@@ -85,12 +118,17 @@ _PALETTE = [
 ]
 
 
-def to_dot(**kw) -> str:
-    """Graphviz: color = round, peripheries = witness, bold = famous."""
+def to_dot(registry=None, **kw) -> str:
+    """Graphviz: color = round, peripheries = witness, bold = famous.
+    The graph label summarizes per-round fame progress (decided/undecided
+    witnesses); ``registry=`` also records those gauges."""
     rows = export_state(**kw)
+    gauges = fame_gauges(rows, registry=registry)
+    label = "fame per round: " + _fame_summary(gauges, "(no witnesses)")
     lines = [
         "digraph hashgraph {",
         "  rankdir=BT; node [style=filled, shape=box, fontsize=9];",
+        f'  labelloc="t"; label="{label}";',
     ]
     for r in rows:
         color = _PALETTE[(r["round"] or 0) % len(_PALETTE)]
@@ -107,9 +145,10 @@ def to_dot(**kw) -> str:
     return "\n".join(lines)
 
 
-def ascii_lanes(max_height: int = 24, **kw) -> str:
+def ascii_lanes(max_height: int = 24, registry=None, **kw) -> str:
     """Terminal sketch: members as columns, heights as rows, cells show the
-    round number (* witness, ! famous)."""
+    round number (* witness, ! famous).  A footer summarizes per-round
+    fame progress; ``registry=`` also records the gauges."""
     rows = export_state(**kw)
     n_members = max(r["creator"] for r in rows) + 1
     grid: Dict[int, Dict[int, str]] = {}
@@ -131,4 +170,10 @@ def ascii_lanes(max_height: int = 24, **kw) -> str:
     for h in range(top, lo - 1, -1):
         cells = [f"{grid.get(h, {}).get(m, ''):<4}" for m in range(n_members)]
         lines.append(f"{h:6} | " + " ".join(cells))
+    gauges = fame_gauges(rows, registry=registry)
+    lines.append("-" * (9 + 5 * n_members))
+    lines.append(
+        "fame decided/witnesses per round: "
+        + _fame_summary(gauges, "(none)")
+    )
     return "\n".join(lines)
