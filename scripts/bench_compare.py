@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Diff two BENCH_*.json artifacts and fail on throughput regression.
+
+Usage:
+    python scripts/bench_compare.py OLD.json NEW.json [--threshold 0.10]
+                                    [--key value]
+
+Compares ``NEW[key]`` against ``OLD[key]`` (default key: ``value``, the
+headline events/sec) and exits nonzero when the new number is more than
+``threshold`` (default 10%) below the old one.  The incremental
+steady-state throughput (``incremental.steady_evps``) is compared too
+when both files carry it.  Everything else (phases, window stats) is
+printed as an informational diff.
+
+Opt-in wiring: this is NOT part of tier-1 (bench numbers are machine-
+dependent); run it from CI or by hand after a bench run, e.g.::
+
+    python bench.py > /tmp/BENCH_new.json
+    python scripts/bench_compare.py BENCH_r05.json /tmp/BENCH_new.json
+
+(A shape-level smoke test lives in tests/test_aux.py so the tool itself
+cannot rot.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, Optional
+
+
+def _get(d: Dict[str, Any], dotted: str) -> Optional[float]:
+    cur: Any = d
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    try:
+        return float(cur)
+    except (TypeError, ValueError):
+        return None
+
+
+def compare(old: Dict, new: Dict, key: str, threshold: float):
+    """Returns (failures, report_lines)."""
+    lines = []
+    failures = []
+    for k in [key, "incremental.steady_evps"]:
+        ov, nv = _get(old, k), _get(new, k)
+        if ov is None or nv is None:
+            if k == key:
+                failures.append(f"missing key {k!r} in one of the inputs")
+            continue
+        delta = (nv - ov) / ov if ov else 0.0
+        verdict = "ok"
+        if delta < -threshold:
+            verdict = f"REGRESSION (>{threshold:.0%} below old)"
+            failures.append(f"{k}: {ov:.1f} -> {nv:.1f} ({delta:+.1%})")
+        lines.append(f"{k:<28} {ov:>12.1f} -> {nv:>12.1f}  {delta:+7.1%}  {verdict}")
+    op, np_ = old.get("phases") or {}, new.get("phases") or {}
+    for k in sorted(set(op) | set(np_)):
+        ov, nv = op.get(k), np_.get(k)
+        if isinstance(ov, (int, float)) and isinstance(nv, (int, float)):
+            lines.append(f"  phase {k:<28} {ov:>10} -> {nv:>10}")
+    return failures, lines
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("old", help="baseline BENCH json file")
+    ap.add_argument("new", help="candidate BENCH json file")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="allowed fractional drop (default 0.10 = 10%%)")
+    ap.add_argument("--key", default="value",
+                    help="headline metric key (default: value)")
+    args = ap.parse_args(argv)
+    with open(args.old) as f:
+        old = json.load(f)
+    with open(args.new) as f:
+        new = json.load(f)
+    failures, lines = compare(old, new, args.key, args.threshold)
+    for ln in lines:
+        print(ln)
+    if failures:
+        print("\nFAIL:", "; ".join(failures), file=sys.stderr)
+        return 1
+    print("\nOK: no throughput regression beyond "
+          f"{args.threshold:.0%}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -85,3 +85,42 @@ def test_viz_exports_agree_across_backends():
     assert dot.startswith("digraph") and "->" in dot
     lanes = viz.ascii_lanes(node=node)
     assert "m0" in lanes and "height" in lanes
+
+
+def test_bench_compare_tool(tmp_path):
+    """scripts/bench_compare.py: ok within threshold, nonzero on >10%
+    throughput regression (opt-in check wiring)."""
+    import subprocess
+    import sys
+
+    old = {"value": 1000.0, "phases": {"pipeline": 1.0},
+           "incremental": {"steady_evps": 2000.0}}
+    good = {"value": 950.0, "phases": {"pipeline": 1.1},
+            "incremental": {"steady_evps": 2100.0}}
+    bad = {"value": 800.0, "phases": {},
+           "incremental": {"steady_evps": 2100.0}}
+    po, pg, pb = tmp_path / "o.json", tmp_path / "g.json", tmp_path / "b.json"
+    po.write_text(json.dumps(old))
+    pg.write_text(json.dumps(good))
+    pb.write_text(json.dumps(bad))
+    r = subprocess.run(
+        [sys.executable, "scripts/bench_compare.py", str(po), str(pg)],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = subprocess.run(
+        [sys.executable, "scripts/bench_compare.py", str(po), str(pb)],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 1
+    assert "REGRESSION" in r.stdout
+    # regression in the incremental metric alone must also fail
+    bad_inc = {"value": 1000.0, "phases": {},
+               "incremental": {"steady_evps": 1500.0}}
+    pbi = tmp_path / "bi.json"
+    pbi.write_text(json.dumps(bad_inc))
+    r = subprocess.run(
+        [sys.executable, "scripts/bench_compare.py", str(po), str(pbi)],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 1

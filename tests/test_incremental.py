@@ -1,0 +1,261 @@
+"""Incremental windowed consensus: bit-parity with the oracle and with
+full-recompute ``run_consensus`` across chunked-ingest schedules, plus the
+steady-state recompile regression (zero new jit-cache entries after
+warmup).
+
+The driver's exactness contract is *detect-or-match*: any ingest pattern
+its window locality cannot answer exactly (stragglers, pruned parents,
+cross-boundary fork pairs) must be answered by a transparent full
+recompute — so every schedule here, however hostile, must still produce
+outputs identical to one batch pass over the final DAG.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from tpu_swirld import obs as obslib
+from tpu_swirld.packing import pack_events, pack_node
+from tpu_swirld.sim import (
+    chunked_ingest_schedule, generate_gossip_dag, make_simulation,
+    run_with_forkers,
+)
+from tpu_swirld.tpu.pipeline import IncrementalConsensus, run_consensus
+
+from tests.test_pipeline import assert_parity
+
+
+def assert_same_result(a, b):
+    """Field-by-field equality of two ConsensusResults (bit-parity)."""
+    assert a.n == b.n
+    assert (a.round == b.round).all()
+    assert (a.is_witness == b.is_witness).all()
+    assert a.famous == b.famous
+    assert (a.round_received == b.round_received).all()
+    assert (a.consensus_ts == b.consensus_ts).all()
+    assert a.order == b.order
+    assert a.max_round == b.max_round
+
+
+def drive(members, stake, config, chunks, **kw):
+    inc = IncrementalConsensus(members, stake, config, **kw)
+    ordered = []
+    for chunk in chunks:
+        ordered.extend(inc.ingest(chunk)["ordered"])
+    return inc, ordered
+
+
+def fixed_chunks(events, size):
+    return [events[i : i + size] for i in range(0, len(events), size)]
+
+
+def test_incremental_parity_small_sim():
+    sim = make_simulation(5, seed=11)
+    sim.run(250)
+    node = sim.nodes[0]
+    events = [node.hg[e] for e in node.order_added]
+    stake = [node.stake[m] for m in node.members]
+    packed = pack_node(node)
+    inc, ordered = drive(
+        node.members, stake, node.config, fixed_chunks(events, 60),
+        block=64, chunk=32, window_bucket=256, prune_min=64,
+    )
+    res = inc.result()
+    ref = run_consensus(packed, node.config, block=64)
+    assert_same_result(res, ref)
+    assert_parity(node, packed, res)           # and vs the oracle itself
+    # incrementally committed order == final order (prefix-stable commits)
+    assert ordered == res.order
+    assert len(res.order) > 0
+
+
+def test_incremental_parity_random_chunk_sizes():
+    """Chunk sizes from 1 event to large, randomized — commit boundaries
+    must never influence any output."""
+    sim = make_simulation(4, seed=7)
+    sim.run(220)
+    node = sim.nodes[0]
+    events = [node.hg[e] for e in node.order_added]
+    stake = [node.stake[m] for m in node.members]
+    packed = pack_node(node)
+    rng = random.Random(3)
+    chunks, i = [], 0
+    while i < len(events):
+        c = rng.choice([1, 2, 7, 25, 80])
+        chunks.append(events[i : i + c])
+        i += c
+    inc, _ = drive(
+        node.members, stake, node.config, chunks,
+        block=64, chunk=32, window_bucket=256, prune_min=32,
+    )
+    assert_same_result(inc.result(), run_consensus(packed, node.config, block=64))
+
+
+def test_incremental_parity_with_forks():
+    """Fork pairs pin pruning (pair members must stay addressable) and
+    exercise the forked fame tally — parity must hold throughout."""
+    sim = run_with_forkers(n_nodes=7, n_forkers=2, n_turns=300, seed=9)
+    node = next(
+        n for n in sim.nodes if any(n.has_fork[m] for m in sim.members)
+    )
+    events = [node.hg[e] for e in node.order_added]
+    stake = [node.stake[m] for m in node.members]
+    packed = pack_node(node)
+    assert len(packed.fork_pairs) > 0
+    inc, _ = drive(
+        node.members, stake, node.config, fixed_chunks(events, 50),
+        block=64, chunk=64, window_bucket=256, prune_min=64,
+    )
+    res = inc.result()
+    assert_same_result(res, run_consensus(packed, node.config, block=64))
+    assert_parity(node, packed, res)
+
+
+def test_incremental_parity_fork_heavy_generated_dag():
+    members, stake, events, _keys = generate_gossip_dag(
+        12, 1200, seed=4, n_forkers=4
+    )
+    packed = pack_events(events, members, stake)
+    assert len(packed.fork_pairs) > 0
+    from tpu_swirld.config import SwirldConfig
+
+    cfg = SwirldConfig(n_members=12)
+    inc, _ = drive(
+        members, stake, cfg, fixed_chunks(events, 150),
+        chunk=128, window_bucket=512, prune_min=128,
+    )
+    assert_same_result(inc.result(), run_consensus(packed, cfg))
+
+
+def test_incremental_parity_straggler_schedule():
+    """Orphan-heavy arrival: events delayed several chunks past their
+    creation order arrive with old parents, driving the documented
+    window-exit fallbacks — outputs must still be bit-identical."""
+    members, stake, events, _keys = generate_gossip_dag(8, 900, seed=6)
+    packed = pack_events(events, members, stake)
+    from tpu_swirld.config import SwirldConfig
+
+    cfg = SwirldConfig(n_members=8)
+    chunks = chunked_ingest_schedule(
+        events, 90, delay_prob=0.2, max_delay=4, seed=1
+    )
+    # the schedule must genuinely reorder deliveries across chunks
+    flat = [ev for chunk in chunks for ev in chunk]
+    assert [ev.id for ev in flat] != [ev.id for ev in events]
+    inc = IncrementalConsensus(
+        members, stake, cfg, block=64, chunk=64, window_bucket=256,
+        prune_min=64,
+    )
+    for chunk in chunks:
+        inc.ingest(chunk)
+    # the incremental packer saw delivery order, so compare against a
+    # batch pass over the *same* delivery order
+    packed_delivery = pack_events(flat, members, stake)
+    assert_same_result(inc.result(), run_consensus(packed_delivery, cfg))
+
+
+def test_incremental_prunes_decided_prefix():
+    """Steady state must actually prune: the carried window stays a small
+    fraction of total history once rounds begin completing."""
+    members, stake, events, _keys = generate_gossip_dag(8, 1600, seed=2)
+    from tpu_swirld.config import SwirldConfig
+
+    cfg = SwirldConfig(n_members=8)
+    inc, _ = drive(
+        members, stake, cfg, fixed_chunks(events, 200),
+        chunk=128, window_bucket=256, prune_min=128,
+    )
+    assert inc.pruned_prefix > 0
+    assert inc.window_size < len(events) // 2
+    assert inc.pruned_prefix + inc.window_size == len(events)
+    packed = pack_events(events, members, stake)
+    assert_same_result(inc.result(), run_consensus(packed, cfg))
+
+
+def test_incremental_empty_and_noop_ingests():
+    inc = IncrementalConsensus([b"m0", b"m1", b"m2"], [1, 1, 1])
+    st = inc.ingest([])
+    assert st["new_events"] == 0 and st["ordered"] == []
+    members, stake, events, _keys = generate_gossip_dag(3, 30, seed=0)
+    inc2 = IncrementalConsensus(members, stake, chunk=32, window_bucket=256)
+    inc2.ingest(events)
+    before = inc2.result()
+    inc2.ingest([])                      # no-op pass: state unchanged
+    assert_same_result(inc2.result(), before)
+
+
+def test_incremental_zero_recompiles_after_warmup():
+    """Recompile-count regression: once the shape buckets have warmed up,
+    the steady-state loop must add ZERO new entries to any stage's jit
+    cache (classified by obs.stage_call watching the jit caches grow)."""
+    members, stake, events, _keys = generate_gossip_dag(16, 3000, seed=5)
+    from tpu_swirld.config import SwirldConfig
+
+    cfg = SwirldConfig(n_members=16)
+    inc = IncrementalConsensus(
+        members, stake, cfg, chunk=128, window_bucket=512, prune_min=128,
+    )
+    chunks = fixed_chunks(events, 250)
+    warmup = (2 * len(chunks)) // 3
+    for chunk in chunks[:warmup]:
+        inc.ingest(chunk)
+    o = obslib.Obs()
+    with obslib.enabled(o):
+        for chunk in chunks[warmup:]:
+            st = inc.ingest(chunk)
+            assert not st["rebased"], "steady state must not rebase"
+    compiles = obslib.compile_counts(o.registry)
+    assert compiles == {}, f"steady-state loop recompiled: {compiles}"
+    # and the steady loop must still be exact
+    packed = pack_events(events, members, stake)
+    assert_same_result(inc.result(), run_consensus(packed, cfg))
+
+
+def test_incremental_matches_oracle_incremental_view():
+    """The per-pass committed order must be a prefix of the final order
+    (commits are irrevocable), and committed outputs must never change
+    across later passes."""
+    sim = make_simulation(5, seed=23)
+    sim.run(260)
+    node = sim.nodes[0]
+    events = [node.hg[e] for e in node.order_added]
+    stake = [node.stake[m] for m in node.members]
+    inc = IncrementalConsensus(
+        node.members, stake, node.config, block=64, chunk=32,
+        window_bucket=256, prune_min=32,
+    )
+    committed = []
+    for chunk in fixed_chunks(events, 40):
+        committed.extend(inc.ingest(chunk)["ordered"])
+        assert inc.result().order[: len(committed)] == committed
+    assert committed == inc.result().order
+
+
+def test_member_slab_extend_pad_rows_do_not_clobber_slot_zero():
+    """Review regression: scatter padding rows used to be clipped onto
+    (member 0, slot 0), racing a genuine write there (an idle member
+    whose pruned window refills from slot 0).  Pads must be dropped."""
+    import jax.numpy as jnp
+
+    from tpu_swirld.tpu.pipeline import member_slabs, member_slabs_extend_stage
+
+    n = 8
+    sees = np.zeros((n, n), dtype=bool)
+    for i in range(4):
+        sees[i, : i + 1] = True            # event i sees 0..i
+    mt = np.array([[3, -1], [0, 1]], dtype=np.int32)   # member0 slot0 = new ev 3
+    a3_0, b3_0 = member_slabs(jnp.asarray(sees), jnp.asarray(mt))
+    # start from slabs that do NOT know event 3 yet, extend with it + pads
+    mt_old = np.array([[-1, -1], [0, 1]], dtype=np.int32)
+    a3, b3 = member_slabs(jnp.asarray(sees), jnp.asarray(mt_old))
+    rows = 4                               # row block [3, 7): 1 real + 3 pads
+    zm = np.array([0, -1, -1, -1], np.int32)
+    zk = np.array([0, -1, -1, -1], np.int32)
+    ze = np.array([3, -1, -1, -1], np.int32)
+    a3, b3 = member_slabs_extend_stage(
+        a3, b3, jnp.asarray(sees), jnp.asarray(mt), np.int32(3),
+        jnp.asarray(zm), jnp.asarray(zk), jnp.asarray(ze), rows=rows,
+    )
+    assert (np.asarray(b3) == np.asarray(b3_0)).all()
+    assert (np.asarray(a3) == np.asarray(a3_0)).all()

@@ -96,3 +96,39 @@ def test_incremental_append_equals_one_shot():
     for field in ("parents", "creator", "seq", "t", "coin", "member_table"):
         assert (getattr(a, field) == getattr(b, field)).all()
     assert a.ids == b.ids
+
+
+def test_packer_pack_reuses_buffers_incrementally():
+    """Satellite contract: pack() snapshots views of the packer's
+    amortized buffers instead of rebuilding every slab — consecutive
+    packs share memory, and incremental extends stay prefix-identical
+    to a from-scratch pack."""
+    from tpu_swirld.packing import Packer, pack_events
+    from tpu_swirld.sim import generate_gossip_dag
+
+    members, stake, events, _keys = generate_gossip_dag(4, 300, seed=1)
+    p = Packer(members, stake)
+    p.extend(events[:200])
+    a = p.pack()
+    b = p.pack()
+    # no appends between packs -> the big per-event slabs share memory
+    for name in ("parents", "creator", "seq", "t", "coin"):
+        assert np.shares_memory(getattr(a, name), getattr(b, name)), name
+    # appends past a snapshot never mutate it
+    snap_parents = a.parents.copy()
+    snap_table = a.member_table.copy()
+    p.extend(events[200:])
+    c = p.pack()
+    assert (a.parents == snap_parents).all()
+    assert (a.member_table == snap_table).all()
+    # incremental result == one-shot pack of the same stream
+    full = pack_events(events, members, stake)
+    assert c.n == full.n
+    assert (c.parents == full.parents).all()
+    assert (c.creator == full.creator).all()
+    assert (c.seq == full.seq).all()
+    assert (c.t == full.t).all()
+    assert (c.coin == full.coin).all()
+    assert (c.member_table == full.member_table).all()
+    assert (c.fork_pairs == full.fork_pairs).all()
+    assert c.ids == full.ids

@@ -65,3 +65,32 @@ def test_config5_shape_256_members_sharded():
     assert (a.round == b.round).all()
     assert a.famous == b.famous
     assert a.order == b.order
+
+
+@pytest.mark.skipif(len(jax.devices()) < 4, reason="needs 4 virtual devices")
+def test_incremental_with_mesh_cols_parity():
+    """IncrementalConsensus with the member-sharded strongly-sees column
+    kernel (shard_map + psum): bit-parity with full recompute, including
+    a member count that needs mesh padding (6 members on 4 devices)."""
+    from tpu_swirld.parallel import make_ssm_cols_fn_for_mesh
+    from tpu_swirld.tpu.pipeline import IncrementalConsensus
+
+    sim = make_simulation(6, seed=19)
+    sim.run(300)
+    node = sim.nodes[0]
+    packed = pack_node(node)
+    events = [node.hg[e] for e in node.order_added]
+    stake = [node.stake[m] for m in node.members]
+    inc = IncrementalConsensus(
+        node.members, stake, node.config, block=64, chunk=64,
+        window_bucket=256, prune_min=64,
+        ssm_cols_fn=make_ssm_cols_fn_for_mesh(make_mesh(4)),
+    )
+    for i in range(0, len(events), 80):
+        inc.ingest(events[i : i + 80])
+    res = inc.result()
+    ref = run_consensus(packed, node.config, block=64)
+    assert res.order == ref.order
+    assert res.famous == ref.famous
+    assert (res.round == ref.round).all()
+    assert (res.round_received == ref.round_received).all()

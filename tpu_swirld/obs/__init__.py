@@ -194,3 +194,22 @@ def _jit_cache_size(fn) -> int:
         return fn._cache_size()
     except Exception:
         return -1
+
+
+def compile_counts(registry) -> dict:
+    """Per-stage count of jit-cache-growing calls recorded by
+    :func:`stage_call` (``kind == "compile"``) in ``registry``.
+
+    The steady-state recompile regression test wraps a warm loop in a
+    fresh Obs and asserts this comes back empty — i.e. the loop added
+    zero new entries to any stage's jit cache.
+    """
+    out: dict = {}
+    for m in registry.metrics():
+        if m.name != "pipeline_stage_calls":
+            continue
+        labels = dict(m.labels)
+        if labels.get("kind") == "compile":
+            stage = labels.get("stage", "?")
+            out[stage] = out.get(stage, 0) + int(m.value)
+    return out

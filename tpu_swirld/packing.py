@@ -55,7 +55,18 @@ class PackedDAG:
 
 
 class Packer:
-    """Append-only incremental packer (one per consensus engine instance)."""
+    """Append-only incremental packer (one per consensus engine instance).
+
+    Columns live in amortized-doubling numpy buffers written in place by
+    :meth:`append`, so :meth:`pack` is O(1) in the already-packed prefix:
+    it snapshots read-only *views* of the buffers instead of rebuilding
+    every slab from the python lists (the old behaviour made each steady-
+    state repack O(N)).  Appends only ever write *past* the snapshotted
+    length and buffer growth reallocates rather than resizing in place, so
+    earlier snapshots stay valid forever.
+    """
+
+    _INIT_CAP = 256
 
     def __init__(self, members: Sequence[bytes], stake: Sequence[int]):
         if len(members) != len(stake):
@@ -64,19 +75,61 @@ class Packer:
         self.member_index: Dict[bytes, int] = {m: i for i, m in enumerate(members)}
         self.stake = np.asarray(stake, dtype=np.int32)
         self.idx: Dict[bytes, int] = {}         # event id -> index
-        self._parents: List[Tuple[int, int]] = []
-        self._creator: List[int] = []
-        self._seq: List[int] = []
-        self._t: List[int] = []
-        self._coin: List[int] = []
+        self._n = 0
+        cap = self._INIT_CAP
+        self._parents = np.full((cap, 2), -1, dtype=np.int32)
+        self._creator = np.zeros((cap,), dtype=np.int32)
+        self._seq = np.zeros((cap,), dtype=np.int32)
+        self._t = np.zeros((cap,), dtype=np.int64)
+        self._coin = np.zeros((cap,), dtype=np.uint8)
         self._ids: List[bytes] = []
         self._sigs: List[bytes] = []
-        self._member_events: List[List[int]] = [[] for _ in members]
+        self._member_counts = np.zeros((len(members),), dtype=np.int32)
         self._by_seq: List[Dict[int, List[int]]] = [{} for _ in members]
-        self._fork_pairs: List[Tuple[int, int, int]] = []
+        self._k = 1                              # member_table column capacity
+        self._member_table = np.full((len(members), self._k), -1, dtype=np.int32)
+        self._fork_pairs = np.zeros((0, 3), dtype=np.int32)
+        self._n_fork_pairs = 0
+        self.packs = 0                           # observability: pack() calls
 
     def __len__(self) -> int:
-        return len(self._ids)
+        return self._n
+
+    def _grow(self, need: int) -> None:
+        cap = self._parents.shape[0]
+        if need <= cap:
+            return
+        new_cap = max(cap * 2, need)
+
+        def regrow(a, fill):
+            out = np.full((new_cap,) + a.shape[1:], fill, a.dtype)
+            out[: self._n] = a[: self._n]
+            return out
+
+        self._parents = regrow(self._parents, -1)
+        self._creator = regrow(self._creator, 0)
+        self._seq = regrow(self._seq, 0)
+        self._t = regrow(self._t, 0)
+        self._coin = regrow(self._coin, 0)
+
+    def _grow_member_table(self, k: int) -> None:
+        if k <= self._k:
+            return
+        new_k = max(self._k * 2, k)
+        out = np.full((len(self.members), new_k), -1, dtype=np.int32)
+        out[:, : self._k] = self._member_table
+        self._member_table = out
+        self._k = new_k
+
+    def _push_fork_pair(self, row: Tuple[int, int, int]) -> None:
+        g = self._n_fork_pairs
+        if g >= self._fork_pairs.shape[0]:
+            new_cap = max(8, self._fork_pairs.shape[0] * 2)
+            out = np.full((new_cap, 3), -1, dtype=np.int32)
+            out[:g] = self._fork_pairs[:g]
+            self._fork_pairs = out
+        self._fork_pairs[g] = row
+        self._n_fork_pairs = g + 1
 
     def append(self, ev: Event) -> int:
         """Pack one event (parents must already be packed).  Idempotent."""
@@ -87,58 +140,97 @@ class Packer:
         ci = self.member_index.get(ev.c)
         if ci is None:
             raise ValueError("unknown creator")
-        i = len(self._ids)
+        i = self._n
+        self._grow(i + 1)
         if ev.p:
             sp = self.idx.get(ev.p[0])
             op = self.idx.get(ev.p[1])
             if sp is None or op is None:
                 raise ValueError("parent not packed (append in topo order)")
-            seq = self._seq[sp] + 1
-            self._parents.append((sp, op))
+            seq = int(self._seq[sp]) + 1
+            self._parents[i] = (sp, op)
         else:
             seq = 0
-            self._parents.append((-1, -1))
+            self._parents[i] = (-1, -1)
         self.idx[eid] = i
-        self._creator.append(ci)
-        self._seq.append(seq)
-        self._t.append(int(ev.t))
-        self._coin.append(ev.coin_bit() & 1)
+        self._creator[i] = ci
+        self._seq[i] = seq
+        self._t[i] = int(ev.t)
+        self._coin[i] = ev.coin_bit() & 1
+        self._n = i + 1
         self._ids.append(eid)
         self._sigs.append(ev.s)
-        self._member_events[ci].append(i)
+        slot = int(self._member_counts[ci])
+        self._grow_member_table(slot + 1)
+        self._member_table[ci, slot] = i
+        self._member_counts[ci] = slot + 1
         group = self._by_seq[ci].setdefault(seq, [])
         for other in group:            # every prior same-(creator, seq) event
-            self._fork_pairs.append((ci, other, i))
+            self._push_fork_pair((ci, other, i))
         group.append(i)
         return i
 
     def extend(self, events: Iterable[Event]) -> List[int]:
         return [self.append(ev) for ev in events]
 
-    def pack(self) -> PackedDAG:
-        n = len(self._ids)
-        m = len(self.members)
-        k = max((len(ev) for ev in self._member_events), default=0)
-        k = max(k, 1)
-        member_table = np.full((m, k), -1, dtype=np.int32)
-        for ci, evs in enumerate(self._member_events):
-            member_table[ci, : len(evs)] = evs
-        fork_pairs = (
-            np.asarray(self._fork_pairs, dtype=np.int32).reshape(-1, 3)
-            if self._fork_pairs
-            else np.zeros((0, 3), dtype=np.int32)
+    # ---- bounded read-only views (the incremental driver's surface:
+    # keeps the buffer layout private to this file; same freeze contract
+    # as pack())
+
+    def window_view(self, start: int, stop: Optional[int] = None):
+        """Read-only ``(parents, creator, coin, t)`` column views for the
+        packed events [start, stop) — an ingest delta."""
+        stop = self._n if stop is None else stop
+        return (
+            self._ro(self._parents[start:stop]),
+            self._ro(self._creator[start:stop]),
+            self._ro(self._coin[start:stop]),
+            self._ro(self._t[start:stop]),
         )
+
+    @property
+    def n_fork_pairs(self) -> int:
+        return self._n_fork_pairs
+
+    def fork_pairs_view(self, start: int = 0) -> np.ndarray:
+        """Read-only fork-pair rows [start, n_fork_pairs)."""
+        return self._ro(self._fork_pairs[start : self._n_fork_pairs])
+
+    def sig(self, i: int) -> bytes:
+        return self._sigs[i]
+
+    def event_id(self, i: int) -> bytes:
+        return self._ids[i]
+
+    @staticmethod
+    def _ro(view: np.ndarray) -> np.ndarray:
+        """Freeze a buffer view: snapshots share memory with the live
+        packer, so in-place mutation by a consumer must be an error, not
+        silent corruption of every other outstanding snapshot."""
+        view = view[:]
+        view.flags.writeable = False
+        return view
+
+    def pack(self) -> PackedDAG:
+        n = self._n
+        m = len(self.members)
+        k = max(int(self._member_counts.max(initial=0)), 1)
+        self.packs += 1
         return PackedDAG(
             n=n,
             n_members=m,
-            parents=np.asarray(self._parents, dtype=np.int32).reshape(n, 2),
-            creator=np.asarray(self._creator, dtype=np.int32),
-            seq=np.asarray(self._seq, dtype=np.int32),
-            t=np.asarray(self._t, dtype=np.int64),
-            coin=np.asarray(self._coin, dtype=np.uint8),
+            parents=self._ro(self._parents[:n]),
+            creator=self._ro(self._creator[:n]),
+            seq=self._ro(self._seq[:n]),
+            t=self._ro(self._t[:n]),
+            coin=self._ro(self._coin[:n]),
             stake=self.stake.copy(),
-            fork_pairs=fork_pairs,
-            member_table=member_table,
+            # the member table is the one slab a future append may write
+            # *inside* (a member's next slot can sit below another member's
+            # column high-water mark), so it is copied; it is O(N/M * M) =
+            # O(N) int32 but tiny next to the O(N) views above being free
+            fork_pairs=self._fork_pairs[: self._n_fork_pairs].copy(),
+            member_table=self._member_table[:, :k].copy(),
             ids=list(self._ids),
             sigs=list(self._sigs),
         )

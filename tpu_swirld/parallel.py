@@ -103,6 +103,75 @@ def ssm_matrix_sharded(sees, member_table, stake, tot_stake, dtype, *, mesh):
     return f(sees, member_table, stake)
 
 
+_mesh_cols_fns = {}
+
+
+def make_ssm_cols_fn_for_mesh(mesh: Mesh):
+    """Member-sharded strongly-sees *columns* — the windowed counterpart of
+    :func:`ssm_matrix_sharded`, matching the ``ssm_cols_fn`` seam of
+    :func:`tpu_swirld.tpu.pipeline.ssm_cols_stage` /
+    :class:`~tpu_swirld.tpu.pipeline.IncrementalConsensus`.
+
+    Each device owns M/D members' pre-gathered visibility slabs and
+    computes its members' (N, K) @ (K, C) hops locally; the int32 stake
+    tallies ride one ``lax.psum`` over the member axis.  The member axis
+    is padded to a mesh multiple here (pad slabs are all-invalid and pad
+    stake is 0, so they contribute nothing).
+    """
+    d = int(mesh.devices.size)
+    fn = _mesh_cols_fns.get(mesh)
+    if fn is None:
+
+        @functools.partial(
+            jax.jit, static_argnames=("tot_stake", "matmul_dtype_name")
+        )
+        def kernel(a3, b3, stake, cols, *, tot_stake, matmul_dtype_name):
+            dtype = (
+                jnp.bfloat16 if matmul_dtype_name == "bfloat16"
+                else jnp.float32
+            )
+            m = a3.shape[0]
+            m_pad = ((m + d - 1) // d) * d
+            if m_pad != m:
+                a3 = jnp.pad(a3, ((0, m_pad - m), (0, 0), (0, 0)))
+                b3 = jnp.pad(b3, ((0, m_pad - m), (0, 0), (0, 0)))
+                stake = jnp.pad(stake, ((0, m_pad - m),))
+
+            @functools.partial(
+                _shard_map,
+                mesh=mesh,
+                in_specs=(
+                    P(MEMBER_AXIS, None, None),
+                    P(MEMBER_AXIS, None, None),
+                    P(MEMBER_AXIS),
+                    P(None),
+                ),
+                out_specs=P(None, None),
+            )
+            def f(a3l, b3l, stkl, colsl):
+                n = a3l.shape[1]
+                colsc = jnp.clip(colsl, 0, n - 1)
+                cv = colsl >= 0
+
+                def body(mm, acc):
+                    b_cols = b3l[mm][:, colsc] & cv[None, :]
+                    hit = _bmm(a3l[mm], b_cols, dtype)
+                    return acc + stkl[mm] * hit.astype(jnp.int32)
+
+                acc0 = jnp.zeros((n, colsl.shape[0]), dtype=jnp.int32)
+                if hasattr(lax, "pcast"):
+                    acc0 = lax.pcast(acc0, (MEMBER_AXIS,), to="varying")
+                acc = lax.fori_loop(0, a3l.shape[0], body, acc0)
+                acc = lax.psum(acc, MEMBER_AXIS)
+                return (3 * acc > 2 * tot_stake) & cv[None, :]
+
+            return f(a3, b3, stake, cols)
+
+        fn = kernel
+        _mesh_cols_fns[mesh] = fn
+    return fn
+
+
 _mesh_fns = {}
 
 

@@ -364,6 +364,41 @@ def run_with_divergent_forkers(
     )
 
 
+def chunked_ingest_schedule(
+    events,
+    chunk_size: int,
+    *,
+    delay_prob: float = 0.0,
+    max_delay: int = 3,
+    seed: int = 0,
+):
+    """Split a topo-ordered event stream into ingest chunks.
+
+    With ``delay_prob`` > 0, individual events are held back by up to
+    ``max_delay`` chunks (children are always pulled along so every chunk
+    stays topologically valid) — an orphan-heavy/straggler arrival
+    schedule for exercising :class:`tpu_swirld.tpu.pipeline.
+    IncrementalConsensus` window-exit paths (events referencing old
+    parents force its documented full-recompute fallbacks).
+    Returns a list of event lists, each in topo order.
+    """
+    rng = random.Random(seed)
+    idx = {ev.id: j for j, ev in enumerate(events)}
+    chunk_of = [0] * len(events)
+    for j, ev in enumerate(events):
+        c = j // chunk_size
+        if delay_prob and rng.random() < delay_prob:
+            c += rng.randrange(1, max_delay + 1)
+        for p in ev.p:
+            c = max(c, chunk_of[idx[p]])
+        chunk_of[j] = c
+    n_chunks = max(chunk_of) + 1 if events else 0
+    out: List[List[Event]] = [[] for _ in range(n_chunks)]
+    for j, ev in enumerate(events):
+        out[chunk_of[j]].append(ev)
+    return out
+
+
 def generate_gossip_dag(
     n_members: int,
     n_events: int,

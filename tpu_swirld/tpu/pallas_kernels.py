@@ -88,17 +88,8 @@ def ssm_matrix_pallas(
     ``run_consensus(..., use_pallas_ssm=True)``)."""
     n = sees.shape[0]
     n_members, k = member_table.shape
-
-    def fit(t):
-        t = min(t, n)
-        while n % t:           # largest divisor of n at or below the request
-            t //= 2
-        if t < 8:
-            raise ValueError(f"no usable tile for n={n}")
-        return t
-
-    tile_m = fit(tile_m)
-    tile_n = fit(tile_n)
+    tile_m = _fit_tile(tile_m, n)
+    tile_n = _fit_tile(tile_n, n)
     k_pad = max(128, ((k + 127) // 128) * 128)
 
     idx = member_table.reshape(-1)
@@ -159,3 +150,101 @@ def make_ssm_fn(*, interpret: bool = False, tile_m: int = 256,
         )
 
     return ssm_fn
+
+
+def _fit_tile(t: int, n: int) -> int:
+    """Shrink the requested tile by halving until it divides ``n`` (all
+    pipeline shapes are power-of-two-friendly buckets; a non-dividing
+    odd ``n`` is rejected rather than searched for exotic divisors)."""
+    t = min(t, n)
+    while n % t:
+        t //= 2
+    if t < 8:
+        raise ValueError(f"no usable tile for n={n}")
+    return t
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("tot_stake", "matmul_dtype_name", "tile_m", "tile_n",
+                     "interpret"),
+)
+def ssm_cols_pallas(a3, b3, stake, cols, *, tot_stake, matmul_dtype_name,
+                    tile_m: int = 256, tile_n: int = 128,
+                    interpret: bool = False):
+    """Strongly-sees *columns* from the pre-gathered member slabs as one
+    Pallas kernel — the windowed counterpart of :func:`ssm_matrix_pallas`,
+    matching the ``ssm_cols_fn`` seam of
+    :func:`tpu_swirld.tpu.pipeline.ssm_cols_stage`.
+
+    The column gather (``b3[:, :, cols]``) happens in XLA; the kernel then
+    walks a ``(N/Tm, C/Tn, M)`` grid with the member axis innermost,
+    accumulating the per-tile stake tally in VMEM scratch exactly as the
+    full-matrix kernel does — the int32 tally never touches HBM.
+    """
+    matmul_dtype = (
+        jnp.bfloat16 if matmul_dtype_name == "bfloat16" else jnp.float32
+    )
+    n_members, n, k = a3.shape
+    c = cols.shape[0]
+    tile_m = _fit_tile(tile_m, n)
+    tile_n = _fit_tile(tile_n, c)
+    k_pad = max(128, ((k + 127) // 128) * 128)
+    colsc = jnp.clip(cols, 0, n - 1)
+    col_valid = cols >= 0
+    a = a3.transpose(1, 0, 2)                                   # N, M, K
+    b_cols = b3[:, :, colsc] & col_valid[None, None, :]         # M, K, C
+    if k_pad != k:
+        a = jnp.pad(a, ((0, 0), (0, 0), (0, k_pad - k)))
+        b_cols = jnp.pad(b_cols, ((0, 0), (0, k_pad - k), (0, 0)))
+    a = a.reshape(n, n_members * k_pad).astype(matmul_dtype)
+    b_cols = b_cols.reshape(n_members * k_pad, c).astype(matmul_dtype)
+
+    kernel = functools.partial(
+        _ssm_kernel, n_members=n_members, tot_stake=tot_stake
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n, c), jnp.bool_),
+        grid=(n // tile_m, c // tile_n, n_members),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),              # stake
+            pl.BlockSpec(
+                (tile_m, k_pad),
+                lambda i, j, m: (i, m),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (k_pad, tile_n),
+                lambda i, j, m: (m, j),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (tile_m, tile_n),
+            lambda i, j, m: (i, j),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[pltpu.VMEM((tile_m, tile_n), jnp.int32)],
+        interpret=interpret,
+        compiler_params=_compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+    )(stake.astype(jnp.int32), a, b_cols)
+    return out & col_valid[None, :]
+
+
+def make_ssm_cols_fn(*, interpret: bool = False, tile_m: int = 256,
+                     tile_n: int = 128):
+    """Adapter matching the ``ssm_cols_fn`` seam of the incremental driver
+    (:class:`tpu_swirld.tpu.pipeline.IncrementalConsensus`) and of
+    :func:`tpu_swirld.tpu.pipeline._columns_pass`."""
+
+    def ssm_cols_fn(a3, b3, stake, cols, *, tot_stake, matmul_dtype_name):
+        return ssm_cols_pallas(
+            a3, b3, stake, cols, tot_stake=tot_stake,
+            matmul_dtype_name=matmul_dtype_name,
+            tile_m=tile_m, tile_n=tile_n, interpret=interpret,
+        )
+
+    return ssm_cols_fn

@@ -63,7 +63,7 @@ from jax import lax
 from tpu_swirld import crypto, obs
 from tpu_swirld.config import SwirldConfig
 from tpu_swirld.oracle.node import xor_bytes
-from tpu_swirld.packing import PackedDAG
+from tpu_swirld.packing import PackedDAG, Packer
 
 INT32_MAX = np.iinfo(np.int32).max
 
@@ -233,6 +233,7 @@ def rounds_scan(
     """
     step = _make_rounds_step(
         parents, ssm, creator, stake, tot_stake, n_valid,
+        jnp.zeros((), dtype=jnp.int32),
         r_max=r_max, s_max=s_max, has_forks=has_forks, col_pos=None,
     )
     n = parents.shape[0]
@@ -249,10 +250,18 @@ def rounds_scan(
     return rnd, wits, tab, cnt, overflow
 
 
-def _make_rounds_step(parents, ssm, creator, stake, tot_stake, n_valid, *,
-                      r_max, s_max, has_forks, col_pos):
+def _make_rounds_step(parents, ssm, creator, stake, tot_stake, n_valid,
+                      r_base, *, r_max, s_max, has_forks, col_pos):
     """The shared per-event body of the rounds scan.  Carry:
-    (rnd[N], wits[N], wit_table, wit_count, overflow)."""
+    (rnd[N], wits[N], wit_table, wit_count, overflow).
+
+    ``rnd`` holds *global* round values; the witness table holds only the
+    retained round window — row ``k`` is global round ``r_base + k``
+    (``r_base`` a traced scalar so window shifts never retrace).  The
+    batch path passes ``r_base = 0``.  An event landing below the window
+    (round < r_base — a straggler in the incremental path) trips the
+    overflow flag; the incremental driver turns that into a full rebase.
+    """
     n = parents.shape[0]
     n_members = stake.shape[0]
     marange = jnp.arange(n_members)
@@ -265,9 +274,10 @@ def _make_rounds_step(parents, ssm, creator, stake, tot_stake, n_valid, *,
         p1c = jnp.maximum(p1, 0)
         p2c = jnp.maximum(p2, 0)
         r0 = jnp.maximum(rnd[p1c], rnd[p2c])
-        r0c = jnp.clip(r0, 0, r_max - 1)
+        r0w = r0 - r_base                                   # window row
+        r0c = jnp.clip(r0w, 0, r_max - 1)
         widx = tab[r0c]                                     # S
-        wvalid = widx >= 0
+        wvalid = (widx >= 0) & (r0w >= 0) & (r0w < r_max)
         widxc = jnp.clip(widx, 0, n - 1)
         if col_pos is None:
             ss = ssm[i, widxc] & wvalid                     # S
@@ -287,12 +297,13 @@ def _make_rounds_step(parents, ssm, creator, stake, tot_stake, n_valid, *,
             amount = jnp.sum(stake[creator[widxc]] * ss)
         promoted = 3 * amount > 2 * tot_stake
         r = jnp.where(genesis, 0, r0 + promoted)
+        rw = r - r_base
         is_wit = (genesis | (r > rnd[p1c])) & (i < n_valid)
-        overflow = overflow | (is_wit & (r >= r_max))
-        rc = jnp.clip(r, 0, r_max - 1)
+        overflow = overflow | (is_wit & ((rw >= r_max) | (rw < 0)))
+        rc = jnp.clip(rw, 0, r_max - 1)
         slot = cnt[rc]
         overflow = overflow | (is_wit & (slot >= s_max))
-        do = is_wit & (slot < s_max) & (r < r_max)
+        do = is_wit & (slot < s_max) & (rw < r_max) & (rw >= 0)
         slotc = jnp.clip(slot, 0, s_max - 1)
         tab = tab.at[rc, slotc].set(jnp.where(do, i, tab[rc, slotc]))
         cnt = cnt.at[rc].add(do.astype(jnp.int32))
@@ -319,9 +330,14 @@ def fame_scan(
     *,
     has_forks: bool,
     col_pos: Optional[jnp.ndarray] = None,
-) -> jnp.ndarray:
-    """Virtual fame voting.  Returns famous int8[r_max*s_max] over global
-    witness slots (row-major (round, slot)): 1 famous, 0 not, -1 undecided.
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Virtual fame voting.  Returns ``(famous, decided_at)``: famous
+    int8[r_max*s_max] over witness slots (row-major (round, slot)) — 1
+    famous, 0 not, -1 undecided — and decided_at int32[r_max*s_max], the
+    (table-local) round index whose tally first decided each slot (-1 for
+    undecided slots).  ``decided_at`` lets the incremental driver freeze a
+    vote horizon: a decision is final iff no witness later registers in a
+    round below it.
 
     With ``col_pos``, ``ssm`` is column-restricted (every queried column is
     a witness, so the map is total here — guaranteed by the host loop).
@@ -342,7 +358,7 @@ def fame_scan(
     marange = jnp.arange(n_members)
 
     def step(carry, ry):
-        v_prev, famous = carry                          # bool[S,W], int8[W]
+        v_prev, famous, dec_at = carry                  # bool[S,W], int8[W]
         y_idx = wit_table[ry]                           # S
         y_valid = y_idx >= 0
         ye = jnp.clip(y_idx, 0, n - 1)
@@ -407,19 +423,20 @@ def fame_scan(
         any_dec = eligible.any(0)                       # W
         first_y = jnp.argmax(eligible, axis=0)          # W
         val = v_tally[first_y, jnp.arange(w_max)]
-        famous = jnp.where(
-            (famous < 0) & any_dec, val.astype(jnp.int8), famous
-        )
-        return (vote, famous), None
+        newly = (famous < 0) & any_dec
+        famous = jnp.where(newly, val.astype(jnp.int8), famous)
+        dec_at = jnp.where(newly, ry, dec_at)
+        return (vote, famous, dec_at), None
 
     carry0 = (
         jnp.zeros((s_max, w_max), dtype=bool),
         jnp.full((w_max,), -1, dtype=jnp.int8),
+        jnp.full((w_max,), -1, dtype=jnp.int32),
     )
-    (v_last, famous), _ = lax.scan(
+    (v_last, famous, dec_at), _ = lax.scan(
         step, carry0, jnp.arange(1, r_max, dtype=jnp.int32)
     )
-    return famous
+    return famous, dec_at
 
 
 # --------------------------------------------------------------- phase 6
@@ -437,7 +454,8 @@ def order_scan(
     n_valid: jnp.ndarray,
     *,
     chain: int,
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    received0: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Round-received + consensus timestamp ranks.
 
     Processes the maximal fame-complete prefix of rounds in ascending
@@ -445,7 +463,13 @@ def order_scan(
     witnesses all have it as an ancestor; its consensus timestamp is the
     lower median of the UFWs' earliest-seeing self-ancestor timestamps
     (as dense ranks — the host maps ranks back to int64 values).
-    Returns (round_received int32[N] (-1 = not received), ts_rank int32[N]).
+    Returns (round_received int32[N] (-1 = not received), ts_rank int32[N],
+    received bool[N]).
+
+    ``received0`` carries already-received flags from earlier incremental
+    passes (those events are skipped; the round indices in the outputs are
+    then relative to the carried window's ``r_base``).  ``max_round`` must
+    be in the same (local) round frame as the witness table rows.
     """
     r_max, s_max = wit_table.shape
     n = anc.shape[0]
@@ -500,14 +524,14 @@ def order_scan(
         return (received, rr_out, ts_out), None
 
     carry0 = (
-        jnp.zeros((n,), dtype=bool),
+        received0 if received0 is not None else jnp.zeros((n,), dtype=bool),
         jnp.full((n,), -1, dtype=jnp.int32),
         jnp.zeros((n,), dtype=jnp.int32),
     )
     (received, rr_out, ts_out), _ = lax.scan(
         step, carry0, jnp.arange(r_max, dtype=jnp.int32)
     )
-    return rr_out, ts_out
+    return rr_out, ts_out, received
 
 
 # ----------------------------------------------------------- fused kernel
@@ -554,17 +578,17 @@ def fame_order_body(
     dt = jnp.bfloat16 if matmul_dtype_name == "bfloat16" else jnp.float32
     tab = wit_table[:r_max]
     cnt = wit_count[:r_max]
-    famous = fame_scan(
+    famous, decided_at = fame_scan(
         tab, sees, ssm, creator, coin, stake, tot_stake, coin_period, dt,
         has_forks=has_forks,
     )
-    rr, cts_rank = order_scan(
+    rr, cts_rank, _received = order_scan(
         anc, tab, cnt, famous, creator, self_parent, t_rank, max_round,
         n_valid, chain=chain,
     )
     return {
-        "famous": famous, "round_received": rr,
-        "consensus_ts_rank": cts_rank,
+        "famous": famous, "fame_decided_at": decided_at,
+        "round_received": rr, "consensus_ts_rank": cts_rank,
     }
 
 
@@ -704,14 +728,15 @@ def ssm_cols_stage(a3, b3, stake, cols, *, tot_stake, matmul_dtype_name):
     static_argnames=("tot_stake", "r_max", "s_max", "has_forks", "chunk"),
 )
 def rounds_chunk_stage(parents, ssm_c, col_pos, creator, stake, n_valid,
-                       rnd, wits, tab, cnt, overflow, start, *,
+                       rnd, wits, tab, cnt, overflow, start, r_base, *,
                        tot_stake, r_max, s_max, has_forks, chunk):
     """One chunk of the rounds scan: events [start, start+chunk) resume
     from the carried (rnd, wits, tab, cnt, overflow) state.  Shares the
     per-event body with rounds_scan — used by the incremental
-    column-restricted path."""
+    column-restricted path.  ``r_base`` (traced) maps global rounds to
+    witness-table rows (0 on the batch path)."""
     step = _make_rounds_step(
-        parents, ssm_c, creator, stake, tot_stake, n_valid,
+        parents, ssm_c, creator, stake, tot_stake, n_valid, r_base,
         r_max=r_max, s_max=s_max, has_forks=has_forks, col_pos=col_pos,
     )
     carry0 = (rnd, wits, tab, cnt, overflow)
@@ -737,17 +762,17 @@ def fame_order_cols_stage(
     dt = jnp.bfloat16 if matmul_dtype_name == "bfloat16" else jnp.float32
     tab = wit_table[:r_max]
     cnt = wit_count[:r_max]
-    famous = fame_scan(
+    famous, decided_at = fame_scan(
         tab, sees, ssm_c, creator, coin, stake, tot_stake, coin_period, dt,
         has_forks=has_forks, col_pos=col_pos,
     )
-    rr, cts_rank = order_scan(
+    rr, cts_rank, _received = order_scan(
         anc, tab, cnt, famous, creator, self_parent, t_rank, max_round,
         n_valid, chain=chain,
     )
     return {
-        "famous": famous, "round_received": rr,
-        "consensus_ts_rank": cts_rank,
+        "famous": famous, "fame_decided_at": decided_at,
+        "round_received": rr, "consensus_ts_rank": cts_rank,
     }
 
 _pallas_rounds_stages = {}
@@ -1070,7 +1095,37 @@ def _run_consensus_columns(
     packed, config, parents, creator, t_rank, coin, stake, member_table,
     ts_unique, *, n, tot, block, r_rounds, s_max, chain, matmul_dtype_name,
 ):
-    """Column-restricted strongly-sees execution (the default path).
+    """Column-restricted strongly-sees execution (the default path) —
+    :func:`_columns_pass` plus host order extraction and timings."""
+    o = obs.current()
+    t_dev0 = time.perf_counter()
+    out, aux = _columns_pass(
+        packed, config, parents, creator, t_rank, coin, stake, member_table,
+        n=n, tot=tot, block=block, r_rounds=r_rounds, s_max=s_max,
+        chain=chain, matmul_dtype_name=matmul_dtype_name,
+    )
+    t_device = time.perf_counter() - t_dev0
+    t_fin0 = time.perf_counter()
+    with _maybe_span(o, "pipeline.finalize"):
+        result = finalize_order(packed, out, ts_unique)
+    if o is not None:
+        o.registry.counter("pipeline_ssm_columns_total").inc(aux["n_cols"])
+        o.registry.counter("pipeline_chunk_scans_total").inc(aux["n_scans"])
+    result.timings = {
+        "device_and_dispatch": round(t_device, 6),
+        "finalize_host": round(time.perf_counter() - t_fin0, 6),
+        "ssm_columns": aux["n_cols"],
+        "ssm_col_iterations": aux["n_scans"],
+    }
+    return result
+
+
+def _columns_pass(
+    packed, config, parents, creator, t_rank, coin, stake, member_table,
+    *, n, tot, block, r_rounds, s_max, chain, matmul_dtype_name,
+    ssm_cols_fn=None,
+):
+    """Column-restricted strongly-sees execution core.
 
     Strongly-see columns are pure DAG functions (round-independent), and
     the rounds scan only queries *witness* columns, so instead of the full
@@ -1081,11 +1136,22 @@ def _run_consensus_columns(
     query in the final pass over each chunk was answered exactly, so the
     result is bit-identical to the full-matrix scan at Θ(N²·W) cost
     (W ≈ 10% of N in gossip DAGs).
+
+    Returns ``(out, aux)``: ``out`` the numpy consensus outputs (for
+    :func:`finalize_order`) and ``aux`` the live device intermediates
+    (visibility slabs, member slabs, the column store) that
+    :class:`IncrementalConsensus` lifts into its carried state on a cold
+    start or rebase.  ``ssm_cols_fn`` overrides the strongly-sees column
+    kernel (signature of :func:`ssm_cols_stage`) — the mesh and Pallas
+    backends plug in here.
     """
     n_pad = parents.shape[0]
     has_forks = bool(len(packed.fork_pairs))
+    if ssm_cols_fn is None:
+        ssm_cols_fn = functools.partial(
+            obs.stage_call, "pipeline.ssm_cols_stage", ssm_cols_stage
+        )
     o = obs.current()
-    t_dev0 = time.perf_counter()
     parents_d = jnp.asarray(parents)
     creator_d = jnp.asarray(creator)
     stake_d = jnp.asarray(stake)
@@ -1122,9 +1188,7 @@ def _run_consensus_columns(
             ssm_c = jnp.pad(ssm_c, ((0, 0), (0, w_cap - ssm_c.shape[1])))
         cols_arr = np.full((batch,), -1, dtype=np.int32)
         cols_arr[: len(events)] = events
-        part = obs.stage_call(
-            "pipeline.ssm_cols_stage",
-            ssm_cols_stage,
+        part = ssm_cols_fn(
             a3, b3, stake_d, jnp.asarray(cols_arr), tot_stake=tot,
             matmul_dtype_name=matmul_dtype_name,
         )
@@ -1163,6 +1227,7 @@ def _run_consensus_columns(
                 rounds_chunk_stage,
                 parents_d, ssm_c, jnp.asarray(col_pos), creator_d,
                 stake_d, n_d, *state, start_d,
+                jnp.zeros((), dtype=jnp.int32),
                 tot_stake=tot, r_max=r_rounds, s_max=s_max,
                 has_forks=has_forks, chunk=chunk_size,
             )
@@ -1226,20 +1291,31 @@ def _run_consensus_columns(
         **stage_b,
     }
     out = jax.tree.map(np.asarray, out)
-    t_device = time.perf_counter() - t_dev0
-    t_fin0 = time.perf_counter()
-    with _maybe_span(o, "pipeline.finalize"):
-        result = finalize_order(packed, out, ts_unique)
-    if o is not None:
-        o.registry.counter("pipeline_ssm_columns_total").inc(n_cols)
-        o.registry.counter("pipeline_chunk_scans_total").inc(n_scans)
-    result.timings = {
-        "device_and_dispatch": round(t_device, 6),
-        "finalize_host": round(time.perf_counter() - t_fin0, 6),
-        "ssm_columns": n_cols,
-        "ssm_col_iterations": n_scans,
+    aux = {
+        "anc": anc, "sees": sees, "ssm_c": ssm_c, "a3": a3, "b3": b3,
+        "col_pos": col_pos, "n_cols": n_cols, "w_cap": w_cap,
+        "n_scans": n_scans, "r_rounds": r_rounds,
     }
-    return result
+    return out, aux
+
+
+def _unique_famous(fam_events, creators) -> List[int]:
+    """Unique famous witnesses of one round: famous witnesses whose
+    creator has exactly one famous witness there — the shared commit rule
+    of :func:`finalize_order` and the incremental driver (keep the two in
+    lock-step: any change here is a consensus-rule change)."""
+    by_creator: Dict[int, List[int]] = {}
+    for e in fam_events:
+        by_creator.setdefault(int(creators[e]), []).append(e)
+    return sorted(e for v in by_creator.values() if len(v) == 1 for e in v)
+
+
+def _whiten_sigs(sigs) -> bytes:
+    """XOR-fold the UFW signatures into the round's tiebreak whitener."""
+    w = bytes(crypto.SIG_BYTES)
+    for s in sigs:
+        w = xor_bytes(w, s)
+    return w
 
 
 def finalize_order(
@@ -1263,12 +1339,7 @@ def finalize_order(
             if f == 1:
                 fam_slots.append(e)
         if fam_slots:
-            by_creator: Dict[int, List[int]] = {}
-            for e in fam_slots:
-                by_creator.setdefault(int(packed.creator[e]), []).append(e)
-            ufw_by_round[r] = sorted(
-                e for v in by_creator.values() if len(v) == 1 for e in v
-            )
+            ufw_by_round[r] = _unique_famous(fam_slots, packed.creator)
 
     rr = out["round_received"][:n]
     # map timestamp ranks back to the int64 values
@@ -1279,9 +1350,7 @@ def finalize_order(
     def whiten(r: int) -> bytes:
         w = whiten_cache.get(r)
         if w is None:
-            w = bytes(crypto.SIG_BYTES)
-            for e in ufw_by_round.get(r, []):
-                w = xor_bytes(w, packed.sigs[e])
+            w = _whiten_sigs(packed.sigs[e] for e in ufw_by_round.get(r, []))
             whiten_cache[r] = w
         return w
 
@@ -1301,3 +1370,1063 @@ def finalize_order(
         order=[i for (_r, _t, _h, i) in received],
         max_round=int(out["max_round"]),
     )
+
+
+# ------------------------------------------- incremental (windowed) stages
+#
+# Steady-state consensus never re-decides the committed prefix: the driver
+# below (:class:`IncrementalConsensus`) carries the visibility slabs, the
+# strongly-sees column store, and the per-round decisions on device between
+# passes, extends them with only the new-event rows/columns, and prunes the
+# decided prefix so every matrix dimension scales with the *undecided
+# window* rather than total history.  All stages take the carried slab as a
+# donated argument so XLA updates it in place where the backend supports
+# donation, and every shape is a session-monotone bucket so the steady
+# loop hits a warm jit cache (no per-pass recompiles).
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("block", "matmul_dtype_name"),
+    donate_argnums=(0,),
+)
+def ancestry_extend_stage(anc, parents, b0, b1, *, block, matmul_dtype_name):
+    """Extend the carried ancestry slab with rows for blocks [b0, b1).
+
+    Identical math to :func:`ancestry` resumed over an existing slab:
+    rows below ``b0 * block`` are read, not recomputed, so the work is
+    O(new rows x window).  A partially filled boundary block is recomputed
+    idempotently (same parent rows -> same values).  Parents of pruned
+    events are remapped to -1 by the driver; that is exact here because a
+    pruned parent's ancestry over the retained columns is all-zero (topo
+    order: nothing retained is older than a pruned event).
+    """
+    dt = jnp.bfloat16 if matmul_dtype_name == "bfloat16" else jnp.float32
+    n = parents.shape[0]
+    n_sq = max(1, math.ceil(math.log2(block)))
+    eye = jnp.eye(block, dtype=bool)
+    jj = jnp.arange(block)
+
+    def body(k, r):
+        s = k * block
+        pb = lax.dynamic_slice(parents, (s, 0), (block, 2))
+        local = pb - s
+        adj = (local[:, 0:1] == jj[None, :]) | (local[:, 1:2] == jj[None, :])
+        lc = adj | eye
+        for _ in range(n_sq):
+            lc = lc | _bmm(lc, lc, dt)
+        pc = jnp.clip(pb, 0, n - 1)
+        ext = (pb >= 0) & (pb < s)
+        g = (r[pc[:, 0]] & ext[:, 0:1]) | (r[pc[:, 1]] & ext[:, 1:2])
+        rows = _bmm(lc, g, dt)
+        diag = lax.dynamic_slice(rows, (0, s), (block, block)) | lc
+        rows = lax.dynamic_update_slice(rows, diag, (0, s))
+        return lax.dynamic_update_slice(r, rows, (s, 0))
+
+    return lax.fori_loop(b0, b1, body, anc)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_members", "rows", "matmul_dtype_name"),
+    donate_argnums=(0,),
+)
+def sees_extend_stage(sees, anc, fork_pairs, creator, row0, *, n_members,
+                      rows, matmul_dtype_name):
+    """Write fork-aware sees rows [row0, row0+rows) from the ancestry slab.
+
+    Only new rows are written: an already-present event never changes its
+    visibility (its ancestry is fixed), and old rows over new columns are
+    structurally zero (topo order), so extension is exact.  ``fork_pairs``
+    are window-remapped; the driver rebases whenever a pair member falls
+    below the pruned boundary, so every pair is addressable here.
+    """
+    dt = jnp.bfloat16 if matmul_dtype_name == "bfloat16" else jnp.float32
+    n = anc.shape[0]
+    anc_rows = lax.dynamic_slice(anc, (row0, 0), (rows, n))
+    if fork_pairs.shape[0] == 0:
+        fseen = jnp.zeros((rows, n_members), dtype=bool)
+    else:
+        mcol = fork_pairs[:, 0]
+        a = jnp.clip(fork_pairs[:, 1], 0, n - 1)
+        b = jnp.clip(fork_pairs[:, 2], 0, n - 1)
+        hit = anc_rows[:, a] & anc_rows[:, b] & (mcol >= 0)[None, :]
+        onehot = mcol[:, None] == jnp.arange(n_members)[None, :]
+        fseen = _bmm(hit, onehot, dt)
+    new_rows = anc_rows & ~fseen[:, creator]
+    return lax.dynamic_update_slice(sees, new_rows, (row0, 0))
+
+
+@functools.partial(jax.jit, static_argnames=("rows",), donate_argnums=(0, 1))
+def member_slabs_extend_stage(a3, b3, sees, member_table, row0, z_m, z_k,
+                              z_e, *, rows):
+    """Extend the per-member visibility slabs for new events.
+
+    a3 ("x sees z", (M, N, K)) gains the new x rows [row0, row0+rows)
+    gathered over the *updated* member table — old rows never see new z
+    (topo order), so their zero padding is already exact.  b3 ("z sees w",
+    (M, K, N)) gains one scattered row per new event z at its (member,
+    slot) position; old z rows never see new w, so their zero columns are
+    exact too.  Scatter padding rows (z_e == -1) are dropped via
+    out-of-bounds indices.
+    """
+    n = sees.shape[0]
+    m, k = member_table.shape
+    idx = member_table.reshape(-1)
+    valid = idx >= 0
+    idxc = jnp.clip(idx, 0, n - 1)
+    sees_rows = lax.dynamic_slice(sees, (row0, 0), (rows, n))
+    a_rows = (
+        (sees_rows[:, idxc] & valid[None, :])
+        .reshape(rows, m, k).transpose(1, 0, 2)
+    )
+    a3 = lax.dynamic_update_slice(a3, a_rows, (0, row0, 0))
+    zv = z_e >= 0
+    zrows = sees[jnp.clip(z_e, 0, n - 1)] & zv[:, None]
+    # padding rows are routed out of bounds and dropped by the scatter;
+    # clipping them to (0, 0) instead would collide with a genuine write
+    # to member 0 slot 0 (duplicate scatter indices, undefined winner)
+    zm = jnp.where(zv, z_m, m)
+    zk = jnp.where(zv, z_k, k)
+    b3 = b3.at[zm, zk].set(zrows, mode="drop")
+    return a3, b3
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("rows", "tot_stake", "matmul_dtype_name"),
+    donate_argnums=(0,),
+)
+def ssm_rows_extend_stage(ssm_c, a3, b3, stake, col_events, row0, *, rows,
+                          tot_stake, matmul_dtype_name):
+    """Strongly-sees values for the new x rows against every existing
+    witness column: per member one (rows, K) @ (K, C) hop, int32 stake
+    tally, strict-2/3 threshold.  Old rows x old columns are untouched
+    (their values never change: new z events are never ancestors of old
+    x), and new columns are filled later by the column kernel."""
+    dt = jnp.bfloat16 if matmul_dtype_name == "bfloat16" else jnp.float32
+    n_members, n, k = a3.shape
+    c = col_events.shape[0]
+    colsc = jnp.clip(col_events, 0, n - 1)
+    col_valid = col_events >= 0
+    b_cols = b3[:, :, colsc] & col_valid[None, None, :]
+
+    def body(m, acc):
+        a_r = lax.dynamic_slice(a3[m], (row0, 0), (rows, k))
+        hit = _bmm(a_r, b_cols[m], dt)
+        return acc + stake[m] * hit.astype(jnp.int32)
+
+    acc = lax.fori_loop(
+        0, n_members, body, jnp.zeros((rows, c), dtype=jnp.int32)
+    )
+    part = (3 * acc > 2 * tot_stake) & col_valid[None, :]
+    return lax.dynamic_update_slice(ssm_c, part, (row0, 0))
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def prune_stage(anc, sees, ssm_c, d, n_used, keep_cols):
+    """Shift the carried slabs down/left by ``d`` pruned events, zero the
+    vacated tail, and gather the surviving witness columns (``keep_cols``
+    indexes the old column slots, -1 = vacate).  Capacities are preserved
+    so the steady loop keeps a single compiled shape."""
+    n = anc.shape[0]
+    live = jnp.arange(n) < (n_used - d)
+    m2 = live[:, None] & live[None, :]
+    anc = jnp.roll(jnp.roll(anc, -d, axis=0), -d, axis=1) & m2
+    sees = jnp.roll(jnp.roll(sees, -d, axis=0), -d, axis=1) & m2
+    kv = keep_cols >= 0
+    kc = jnp.clip(keep_cols, 0, ssm_c.shape[1] - 1)
+    ssm_c = jnp.roll(ssm_c, -d, axis=0)[:, kc] & live[:, None] & kv[None, :]
+    return anc, sees, ssm_c
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "tot_stake", "coin_period", "r_max", "s_max", "has_forks",
+        "matmul_dtype_name",
+    ),
+)
+def fame_window_stage(sees, ssm_c, col_pos, wit_table, creator, coin, stake,
+                      *, tot_stake, coin_period, r_max, s_max, has_forks,
+                      matmul_dtype_name):
+    """Fame voting over the retained round window only.  Round-window
+    locality is exact: votes about a round-r witness only involve rounds
+    > r, and the driver's straggler guard rebases whenever a witness
+    registers below the window, so rows [0, r_max) are self-contained."""
+    dt = jnp.bfloat16 if matmul_dtype_name == "bfloat16" else jnp.float32
+    return fame_scan(
+        wit_table[:r_max], sees, ssm_c, creator, coin, stake, tot_stake,
+        coin_period, dt, has_forks=has_forks, col_pos=col_pos,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("r_max", "s_max", "chain"))
+def order_window_stage(anc, wit_table, wit_count, famous, creator,
+                       self_parent, t_rank, max_round_local, n_valid,
+                       received0, *, r_max, s_max, chain):
+    """Order extraction over the first ``r_max`` retained rounds, resuming
+    from the carried received flags.  Already-committed rounds re-run as
+    no-ops (their received sets are final — new events are never ancestors
+    of old witnesses), so ``r_max`` only needs to reach the newly
+    fame-complete prefix."""
+    return order_scan(
+        anc, wit_table[:r_max], wit_count[:r_max],
+        famous[: r_max * s_max], creator, self_parent, t_rank,
+        max_round_local, n_valid, chain=chain, received0=received0,
+    )
+
+
+# --------------------------------------------------- incremental driver
+
+
+class IncrementalConsensus:
+    """Steady-state consensus driver with carried device state.
+
+    Where :func:`run_consensus` recomputes the full ancestry / sees /
+    strongly-sees matrices on every call, this driver keeps them (plus the
+    witness table and per-round decisions) alive between passes:
+
+    - :meth:`ingest` appends a gossip delta to the internal
+      :class:`~tpu_swirld.packing.Packer`, extends the carried slabs with
+      only the new-event rows/columns, resumes the rounds scan from its
+      carried state, re-votes fame over the *retained round window* only,
+      and extracts the order of newly fame-complete rounds;
+    - the **decided prefix is pruned**: once an event is received (and all
+      fork-pair members stay above the cut), its row/column is dropped
+      from every slab, so matrix work scales with the undecided window
+      rather than total history;
+    - all static shapes are session-monotone buckets, so after a short
+      warmup the steady loop adds **zero new jit-cache entries**, and the
+      carried slabs are donated to the extension stages.
+
+    Exactness contract: every pass leaves the committed outputs **bit-
+    identical** to a cold :func:`run_consensus` over the full DAG.  Window
+    locality is exact for gossip-shaped traffic (new events reference
+    recent parents); the cases where it is not are *detected* and answered
+    with a transparent full recompute ("rebase"):
+
+    - a new event whose parent was already pruned, or whose parent round
+      fell below the retained round window (deep orphan/straggler),
+    - a new witness registering at a round at or below the frozen vote
+      horizon (it could change a committed fame tally),
+    - a new fork pair naming a pruned event,
+    - witness-table overflow (round/slot capacity).
+
+    Rebases rebuild the carried state from the batch pipeline, so they
+    cost one cold pass and the driver keeps going.
+    """
+
+    def __init__(
+        self,
+        members,
+        stake=None,
+        config: Optional[SwirldConfig] = None,
+        *,
+        block: int = 128,
+        chunk: int = 256,
+        window_bucket: int = 1024,
+        prune_min: Optional[int] = None,
+        matmul_dtype_name: Optional[str] = None,
+        ssm_cols_fn=None,
+    ):
+        if stake is None:
+            stake = [1] * len(members)
+        self.packer = Packer(members, stake)
+        self.config = config or SwirldConfig(n_members=len(members))
+        self._block = block
+        self._chunk = max(32, chunk)
+        self._window_bucket = max(256, window_bucket)
+        self._prune_min = (
+            prune_min if prune_min is not None else self._window_bucket // 4
+        )
+        if matmul_dtype_name is None:
+            matmul_dtype_name = (
+                "float32" if jax.default_backend() == "cpu" else "bfloat16"
+            )
+        self._mm = matmul_dtype_name
+        if ssm_cols_fn is None:
+            ssm_cols_fn = functools.partial(
+                obs.stage_call, "pipeline.ssm_cols_stage", ssm_cols_stage
+            )
+        self._ssm_cols_fn = ssm_cols_fn
+        self._stake = np.asarray(stake, dtype=np.int32)
+        self._tot = int(self._stake.sum())
+        self._m = len(members)
+
+        # global committed outputs (amortized-growth buffers)
+        self._round_g = np.zeros((0,), np.int32)
+        self._wits_g = np.zeros((0,), bool)
+        self._rr_g = np.zeros((0,), np.int32)
+        self._cts_g = np.zeros((0,), np.int64)
+        self._order: List[int] = []
+        self._famous_committed: Dict[int, bool] = {}
+
+        # consensus cursors (global rounds / indices)
+        self._initialized = False
+        self._n_done = 0            # events consumed from the packer
+        self._lo = 0                # pruned prefix length (global index)
+        self._r_base = 0            # global round of witness-table row 0
+        self._consensus_round = 0   # next round to order (== r_base at rest)
+        self._frozen_vote_hi = 0    # votes at rounds < this are committed
+        self._max_round = 0
+        self._g_done = 0            # fork pairs already vetted
+
+        # session-monotone static shape buckets (recompile hygiene)
+        self._w_pad = 0             # window row capacity
+        self._wcol_cap = 256        # ssm column capacity
+        self._r_cap = 32            # witness-table rows
+        self._r_fame = 8            # fame round window
+        self._r_ord = 4             # order round window
+        self._chain_cap = 32        # self-chain walk depth
+        self._k_cap = 8             # member-table columns
+        self._g_cap = 0             # fork-pair rows
+        self._s_cap = self._m + 1   # witness slots per round
+
+        # telemetry
+        self.passes = 0
+        self.rebases = 0
+        self.recompiles_hint = 0
+
+    # -------------------------------------------------------- public API
+
+    def __len__(self) -> int:
+        return self._n_done
+
+    @property
+    def window_size(self) -> int:
+        return self._n_done - self._lo
+
+    @property
+    def pruned_prefix(self) -> int:
+        return self._lo
+
+    def ingest(self, events=()) -> Dict:
+        """Feed a topo-ordered gossip delta; run one incremental pass.
+
+        Returns a per-pass stats dict: ``new_events``, ``ordered`` (the
+        packed indices newly committed to the total order, in order),
+        ``window_size``, ``pruned_prefix``, ``rebased``, ``seconds``.
+        """
+        t0 = time.perf_counter()
+        self.packer.extend(events)
+        n_total = len(self.packer)
+        n_new = n_total - self._n_done
+        if n_total == 0 or (n_new == 0 and self._initialized):
+            return self._stats(n_new, [], t0, rebased=False)
+        if not self._initialized or self._needs_rebase_pre():
+            ordered = self._rebase()
+            return self._stats(n_new, ordered, t0, rebased=True)
+        ordered, need_rebase = self._extend_pass(n_new)
+        if need_rebase:
+            ordered = self._rebase()
+            return self._stats(n_new, ordered, t0, rebased=True)
+        return self._stats(n_new, ordered, t0, rebased=False)
+
+    def result(self) -> ConsensusResult:
+        """Cumulative consensus state — bit-identical to a cold
+        :func:`run_consensus` over the same packed DAG."""
+        n = self._n_done
+        famous: Dict[int, Optional[bool]] = dict(self._famous_committed)
+        if self._initialized:
+            for k in range(self._r_cap):
+                for s in range(self._s_cap):
+                    e = int(self._tab_np[k, s])
+                    if e < 0:
+                        continue
+                    f = int(self._famous_np[k, s])
+                    famous[self._lo + e] = None if f < 0 else bool(f)
+        return ConsensusResult(
+            n=n,
+            round=self._round_g[:n].copy(),
+            is_witness=self._wits_g[:n].copy(),
+            famous=famous,
+            round_received=self._rr_g[:n].copy(),
+            consensus_ts=self._cts_g[:n].copy(),
+            order=list(self._order),
+            max_round=self._max_round,
+            timings={
+                "passes": self.passes,
+                "rebases": self.rebases,
+                "window_size": self.window_size,
+                "pruned_prefix": self.pruned_prefix,
+            },
+        )
+
+    # ------------------------------------------------------ pass plumbing
+
+    def _stats(self, n_new, ordered, t0, *, rebased):
+        self.passes += 1
+        if rebased:
+            self.rebases += 1
+        o = obs.current()
+        if o is not None:
+            g = o.registry
+            g.gauge("incremental_window_size").set(self.window_size)
+            g.gauge("incremental_pruned_prefix").set(self.pruned_prefix)
+            g.gauge("incremental_r_base").set(self._r_base)
+            g.counter("incremental_passes_total").inc()
+            if rebased:
+                g.counter("incremental_rebases_total").inc()
+        return {
+            "new_events": int(n_new),
+            "ordered": ordered,
+            "window_size": self.window_size,
+            "pruned_prefix": self.pruned_prefix,
+            "rebased": bool(rebased),
+            "seconds": round(time.perf_counter() - t0, 6),
+        }
+
+    def _grow_global(self, n: int) -> None:
+        if self._round_g.shape[0] >= n:
+            return
+        cap = max(n, 2 * max(1, self._round_g.shape[0]))
+
+        def regrow(a, fill, dtype):
+            out = np.full((cap,), fill, dtype)
+            out[: a.shape[0]] = a
+            return out
+
+        self._round_g = regrow(self._round_g, 0, np.int32)
+        self._wits_g = regrow(self._wits_g, False, bool)
+        self._rr_g = regrow(self._rr_g, -1, np.int32)
+        self._cts_g = regrow(self._cts_g, 0, np.int64)
+
+    def _needs_rebase_pre(self) -> bool:
+        """Host-side guards that must run before touching device state."""
+        p = self.packer
+        lo, n0, n1 = self._lo, self._n_done, len(p)
+        new_par, _, _, _ = p.window_view(n0, n1)
+        live = new_par >= 0
+        if live.any() and int(new_par[live].min()) < lo:
+            return True          # parent already pruned
+        if self._r_base > 0 and (~live[:, 0]).any():
+            return True          # late genesis: a round-0 straggler
+        # Parent rounds must stay inside the retained round window.  Only
+        # events whose parents are BOTH already processed can be checked
+        # against the round mirror; events referencing a parent inside
+        # this same delta are covered by induction (round >= parent round,
+        # and every chain bottoms out in a checked old parent).
+        both_old = live[:, 0] & (new_par < n0).all(axis=1)
+        if both_old.any():
+            pw = np.where(both_old[:, None], new_par - lo, 0)
+            r0 = self._rnd_w[pw].max(axis=1)
+            if int(r0[both_old].min()) < self._r_base:
+                return True
+        # new fork pairs must not name pruned events
+        if p.n_fork_pairs > self._g_done:
+            pairs = p.fork_pairs_view(self._g_done)
+            if int(pairs[:, 1:].min()) < lo:
+                return True
+        return False
+
+    # --------------------------------------------------- capacity buckets
+
+    def _ensure_row_capacity(self, need: int) -> None:
+        if need <= self._w_pad:
+            return
+        new_pad = _bucket(need + self._window_bucket // 2, self._window_bucket)
+        g = new_pad - self._w_pad
+        self._anc_d = jnp.pad(self._anc_d, ((0, g), (0, g)))
+        self._sees_d = jnp.pad(self._sees_d, ((0, g), (0, g)))
+        self._ssm_d = jnp.pad(self._ssm_d, ((0, g), (0, 0)))
+        self._a3_d = jnp.pad(self._a3_d, ((0, 0), (0, g), (0, 0)))
+        self._b3_d = jnp.pad(self._b3_d, ((0, 0), (0, 0), (0, g)))
+        self._grow_mirrors(new_pad)
+        self._w_pad = new_pad
+
+    def _grow_mirrors(self, new_pad: int) -> None:
+        def regrow(a, fill):
+            out = np.full((new_pad,) + a.shape[1:], fill, a.dtype)
+            out[: a.shape[0]] = a
+            return out
+
+        self._parents_w = regrow(self._parents_w, -1)
+        self._creator_w = regrow(self._creator_w, 0)
+        self._coin_w = regrow(self._coin_w, 0)
+        self._t_w = regrow(self._t_w, 0)
+        self._rnd_w = regrow(self._rnd_w, 0)
+        self._wits_w = regrow(self._wits_w, False)
+        self._recv_w = regrow(self._recv_w, False)
+        self._depth_w = regrow(self._depth_w, 0)
+        self._colpos_w = regrow(self._colpos_w, -1)
+
+    def _alloc_mirrors(self, w_pad: int) -> None:
+        self._parents_w = np.full((w_pad, 2), -1, np.int32)
+        self._creator_w = np.zeros((w_pad,), np.int32)
+        self._coin_w = np.zeros((w_pad,), np.uint8)
+        self._t_w = np.zeros((w_pad,), np.int64)
+        self._rnd_w = np.zeros((w_pad,), np.int32)
+        self._wits_w = np.zeros((w_pad,), bool)
+        self._recv_w = np.zeros((w_pad,), bool)
+        self._depth_w = np.zeros((w_pad,), np.int32)
+        self._colpos_w = np.full((w_pad,), -1, np.int32)
+
+    def _grow_k(self, need: int) -> None:
+        new_k = _bucket(need + 4, 8)
+        out = np.full((self._m, new_k), -1, np.int32)
+        out[:, : self._k_cap] = self._mt_np
+        self._mt_np = out
+        self._k_cap = new_k
+
+    def _recompute_depth(self, w_used: int) -> None:
+        d = self._depth_w
+        par = self._parents_w
+        for i in range(w_used):
+            sp = par[i, 0]
+            d[i] = 1 + (d[sp] if sp >= 0 else 0)
+        if int(d[:w_used].max(initial=0)) > self._chain_cap:
+            self._chain_cap = _bucket(int(d[:w_used].max()), 32)
+
+    def _fork_pairs_padded(self) -> np.ndarray:
+        g = self._fork_np.shape[0]
+        if g > self._g_cap:
+            self._g_cap = _bucket(g, 8)
+        out = np.full((self._g_cap, 3), -1, np.int32)
+        out[:g] = self._fork_np
+        return out
+
+    # ----------------------------------------------------- column store
+
+    def _add_columns(self, events: List[int]) -> None:
+        if not events:
+            return
+        batch = _bucket(len(events), 16)
+        if self._n_cols + batch > self._wcol_cap:
+            new_cap = _bucket(
+                max(self._n_cols + batch, self._wcol_cap * 2), 256
+            )
+            self._ssm_d = jnp.pad(
+                self._ssm_d, ((0, 0), (0, new_cap - self._wcol_cap))
+            )
+            ce = np.full((new_cap,), -1, np.int32)
+            ce[: self._wcol_cap] = self._col_events
+            self._col_events = ce
+            self._wcol_cap = new_cap
+        cols_arr = np.full((batch,), -1, np.int32)
+        cols_arr[: len(events)] = events
+        part = self._ssm_cols_fn(
+            self._a3_d, self._b3_d, jnp.asarray(self._stake),
+            jnp.asarray(cols_arr), tot_stake=self._tot,
+            matmul_dtype_name=self._mm,
+        )
+        for j, e in enumerate(events):
+            self._colpos_w[e] = self._n_cols + j
+            self._col_events[self._n_cols + j] = e
+        self._ssm_d = lax.dynamic_update_slice(
+            self._ssm_d, part, (0, self._n_cols)
+        )
+        self._n_cols += len(events)
+
+    # ------------------------------------------------------- extend pass
+
+    def _extend_pass(self, n_new: int) -> Tuple[List[int], bool]:
+        """One incremental pass over the ``n_new`` freshly packed events.
+        Returns ``(newly_ordered, need_rebase)``."""
+        p = self.packer
+        lo = self._lo
+        w0 = self._n_done - lo
+        n1 = len(p)
+        chunk = self._chunk
+        n_pad_new = _bucket(n_new, chunk)
+        self._ensure_row_capacity(w0 + n_pad_new)
+        sl = slice(w0, w0 + n_new)
+        gsl = slice(self._n_done, n1)
+        par, creator_new, coin_new, t_new = p.window_view(self._n_done, n1)
+        parw = np.where(par >= 0, par - lo, -1).astype(np.int32)
+        self._parents_w[sl] = parw
+        self._creator_w[sl] = creator_new
+        self._coin_w[sl] = coin_new
+        self._t_w[sl] = t_new
+        for j in range(n_new):
+            sp = parw[j, 0]
+            self._depth_w[w0 + j] = 1 + (self._depth_w[sp] if sp >= 0 else 0)
+        dmax = int(self._depth_w[: w0 + n_new].max(initial=1))
+        if dmax > self._chain_cap:
+            self._chain_cap = _bucket(dmax, 32)
+        # member slots for the new z events
+        regather = False
+        zm = np.full((n_pad_new,), -1, np.int32)
+        zk = np.full((n_pad_new,), -1, np.int32)
+        ze = np.full((n_pad_new,), -1, np.int32)
+        for j in range(n_new):
+            m = int(creator_new[j])
+            slot = int(self._mcount[m])
+            if slot >= self._k_cap:
+                self._grow_k(slot + 1)
+                regather = True
+            self._mt_np[m, slot] = w0 + j
+            self._mcount[m] = slot + 1
+            zm[j], zk[j], ze[j] = m, slot, w0 + j
+        # fork pairs arriving with this delta (window-remapped)
+        if p.n_fork_pairs > self._g_done:
+            fp = p.fork_pairs_view(self._g_done)
+            new_pairs = np.stack(
+                [fp[:, 0], fp[:, 1] - lo, fp[:, 2] - lo], axis=1,
+            ).astype(np.int32)
+            self._fork_np = np.concatenate([self._fork_np, new_pairs])
+            self._g_done = p.n_fork_pairs
+        has_forks = self._fork_np.shape[0] > 0
+
+        parents_d = jnp.asarray(self._parents_w)
+        creator_d = jnp.asarray(self._creator_w)
+        stake_d = jnp.asarray(self._stake)
+        fork_d = jnp.asarray(self._fork_pairs_padded())
+        n_valid = np.int32(w0 + n_new)
+
+        # ---- device: extend ancestry rows, sees rows, member slabs, ssm rows
+        b0 = w0 // self._block
+        b1 = -(-(w0 + n_new) // self._block)
+        self._anc_d = obs.stage_call(
+            "pipeline.inc_ancestry_extend", ancestry_extend_stage,
+            self._anc_d, parents_d, np.int32(b0), np.int32(b1),
+            block=self._block, matmul_dtype_name=self._mm,
+        )
+        for row0 in range(w0, w0 + n_pad_new, chunk):
+            self._sees_d = obs.stage_call(
+                "pipeline.inc_sees_extend", sees_extend_stage,
+                self._sees_d, self._anc_d, fork_d, creator_d,
+                np.int32(row0), n_members=self._m, rows=chunk,
+                matmul_dtype_name=self._mm,
+            )
+        mt_d = jnp.asarray(self._mt_np)
+        if regather:
+            self._a3_d, self._b3_d = obs.stage_call(
+                "pipeline.member_slabs", member_slabs, self._sees_d, mt_d
+            )
+        else:
+            for row0 in range(w0, w0 + n_pad_new, chunk):
+                j0 = row0 - w0
+                self._a3_d, self._b3_d = obs.stage_call(
+                    "pipeline.inc_member_slabs_extend",
+                    member_slabs_extend_stage,
+                    self._a3_d, self._b3_d, self._sees_d, mt_d,
+                    np.int32(row0), jnp.asarray(zm[j0 : j0 + chunk]),
+                    jnp.asarray(zk[j0 : j0 + chunk]),
+                    jnp.asarray(ze[j0 : j0 + chunk]), rows=chunk,
+                )
+        for row0 in range(w0, w0 + n_pad_new, chunk):
+            self._ssm_d = obs.stage_call(
+                "pipeline.inc_ssm_rows_extend", ssm_rows_extend_stage,
+                self._ssm_d, self._a3_d, self._b3_d, stake_d,
+                jnp.asarray(self._col_events), np.int32(row0), rows=chunk,
+                tot_stake=self._tot, matmul_dtype_name=self._mm,
+            )
+
+        # ---- resumed rounds scan over the new events only
+        state = (
+            jnp.asarray(self._rnd_w),
+            jnp.asarray(self._wits_w),
+            jnp.asarray(self._tab_np),
+            jnp.asarray(self._cnt_np),
+            jnp.zeros((), dtype=bool),
+        )
+        r_base_d = np.int32(self._r_base)
+        for start in range(w0, w0 + n_pad_new, chunk):
+            for _attempt in range(chunk + 1):
+                out = obs.stage_call(
+                    "pipeline.rounds_chunk_stage", rounds_chunk_stage,
+                    parents_d, self._ssm_d, jnp.asarray(self._colpos_w),
+                    creator_d, stake_d, np.int32(n_valid), *state,
+                    np.int32(start), r_base_d,
+                    tot_stake=self._tot, r_max=self._r_cap,
+                    s_max=self._s_cap, has_forks=has_forks, chunk=chunk,
+                )
+                tab = np.asarray(out[2])
+                registered = np.unique(tab[tab >= 0])
+                missing = registered[self._colpos_w[registered] < 0]
+                if missing.size == 0:
+                    state = out
+                    break
+                rnd_np = np.asarray(out[0])
+                ce = np.arange(start, start + chunk)
+                pc = self._parents_w[ce]
+                r0 = np.where(
+                    pc[:, 0] < 0,
+                    -1,
+                    np.maximum(rnd_np[np.maximum(pc[:, 0], 0)],
+                               rnd_np[np.maximum(pc[:, 1], 0)]),
+                )
+                affected = False
+                for w in missing:
+                    if w < start:
+                        affected = True
+                        break
+                    later = ce > w
+                    if np.any(later & (r0 == rnd_np[w])):
+                        affected = True
+                        break
+                self._add_columns([int(e) for e in missing])
+                if not affected:
+                    state = out
+                    break
+            else:
+                raise RuntimeError("witness-column chunk did not converge")
+
+        # np.array (not asarray): device pulls are read-only views, and
+        # these mirrors are mutated in place by the roll/prune paths
+        rnd_w = np.array(state[0])
+        wits_w = np.array(state[1])
+        tab_np = np.array(state[2])
+        cnt_np = np.array(state[3])
+        if bool(np.asarray(state[4])):
+            return [], True          # round/slot capacity overflow -> rebase
+        # straggler guard: a witness below the frozen vote horizon could
+        # change a committed tally — recompute from scratch instead
+        wit_mask = wits_w[sl]
+        if wit_mask.any():
+            wr = rnd_w[sl][wit_mask]
+            if int(wr.min()) < max(self._frozen_vote_hi,
+                                   self._consensus_round):
+                return [], True
+        self._rnd_w = rnd_w
+        self._wits_w = wits_w
+        self._tab_np = tab_np
+        self._cnt_np = cnt_np
+        self._max_round = max(
+            self._max_round, int(rnd_w[: w0 + n_new].max(initial=0))
+        )
+        self._grow_global(n1)
+        self._round_g[gsl] = rnd_w[sl]
+        self._wits_g[gsl] = wit_mask
+        self._n_done = n1
+
+        # ---- fame over the retained round window
+        need = self._max_round - self._r_base + 3
+        if need > self._r_fame:
+            self._r_fame = min(self._r_cap, _bucket(need, 8))
+        famous_d, dec_d = obs.stage_call(
+            "pipeline.inc_fame", fame_window_stage,
+            self._sees_d, self._ssm_d, jnp.asarray(self._colpos_w),
+            state[2], creator_d, jnp.asarray(self._coin_w), stake_d,
+            tot_stake=self._tot, coin_period=self.config.coin_period,
+            r_max=self._r_fame, s_max=self._s_cap, has_forks=has_forks,
+            matmul_dtype_name=self._mm,
+        )
+        fam = np.full((self._r_cap, self._s_cap), -1, np.int8)
+        fam[: self._r_fame] = np.asarray(famous_d).reshape(
+            self._r_fame, self._s_cap
+        )
+        dec = np.full((self._r_cap, self._s_cap), -1, np.int32)
+        dec[: self._r_fame] = np.asarray(dec_d).reshape(
+            self._r_fame, self._s_cap
+        )
+        self._famous_np = fam
+        self._dec_np = dec
+
+        # ---- order extraction for newly fame-complete rounds
+        k_done = self._consensus_round - self._r_base
+        ncomp = 0
+        for k in range(self._r_cap):
+            valid = self._tab_np[k] >= 0
+            if self._cnt_np[k] <= 0:
+                break
+            if self._max_round < self._r_base + k + 2:
+                break
+            if (fam[k][valid] < 0).any():
+                break
+            ncomp = k + 1
+        ordered_new: List[int] = []
+        if ncomp > k_done:
+            if ncomp > self._r_ord:
+                self._r_ord = min(self._r_cap, _bucket(ncomp, 2))
+            ts_unique, t_rank = np.unique(self._t_w, return_inverse=True)
+            t_rank = t_rank.astype(np.int32).reshape(self._t_w.shape)
+            rr_d, ts_d, recv_d = obs.stage_call(
+                "pipeline.inc_order", order_window_stage,
+                self._anc_d, state[2], state[3],
+                jnp.asarray(fam.reshape(-1)), creator_d, parents_d[:, 0],
+                jnp.asarray(t_rank),
+                np.int32(self._max_round - self._r_base),
+                np.int32(n_valid), jnp.asarray(self._recv_w),
+                r_max=self._r_ord, s_max=self._s_cap,
+                chain=self._chain_cap,
+            )
+            rr_np = np.asarray(rr_d)
+            tsr_np = np.asarray(ts_d)
+            recv_np = np.array(recv_d)
+            max_dec = self._frozen_vote_hi
+            for k in range(k_done, ncomp):
+                slots = self._tab_np[k]
+                fam_events: List[int] = []
+                for s in range(self._s_cap):
+                    e = int(slots[s])
+                    if e < 0:
+                        continue
+                    is_f = int(fam[k, s]) == 1
+                    self._famous_committed[lo + e] = is_f
+                    if is_f:
+                        fam_events.append(e)
+                    max_dec = max(max_dec, self._r_base + int(dec[k, s]))
+                ufw = _unique_famous(fam_events, self._creator_w)
+                whiten = _whiten_sigs(p.sig(lo + e) for e in ufw)
+                entries = []
+                for w in np.where(rr_np == k)[0]:
+                    gi = lo + int(w)
+                    cts = int(ts_unique[tsr_np[w]])
+                    tie = crypto.hash_bytes(whiten + p.event_id(gi))
+                    entries.append((cts, tie, gi))
+                entries.sort(key=lambda x: (x[0], x[1]))
+                for cts, _tie, gi in entries:
+                    self._rr_g[gi] = self._r_base + k
+                    self._cts_g[gi] = cts
+                    self._order.append(gi)
+                    ordered_new.append(gi)
+            self._frozen_vote_hi = max_dec
+            self._consensus_round = self._r_base + ncomp
+            self._recv_w = recv_np
+
+        # ---- advance the round window and prune the decided prefix
+        dr = self._consensus_round - self._r_base
+        if dr > 0:
+            self._roll_rounds(dr)
+        self._maybe_prune()
+        return ordered_new, False
+
+    def _roll_rounds(self, dr: int) -> None:
+        def roll(a, fill):
+            out = np.full_like(a, fill)
+            out[:-dr] = a[dr:]
+            return out
+
+        self._tab_np = roll(self._tab_np, -1)
+        self._cnt_np = roll(self._cnt_np, 0)
+        self._famous_np = roll(self._famous_np, -1)
+        self._dec_np = roll(self._dec_np, -1)
+        self._r_base += dr
+
+    # ------------------------------------------------------------- prune
+
+    def _maybe_prune(self) -> None:
+        w_used = self._n_done - self._lo
+        if w_used == 0:
+            return
+        nr = ~self._recv_w[:w_used]
+        d = int(np.argmax(nr)) if nr.any() else w_used
+        if self._fork_np.shape[0]:
+            d = min(d, int(self._fork_np[:, 1:].min()))
+        if d < self._prune_min:
+            return
+        keep = np.full((self._wcol_cap,), -1, np.int32)
+        kept_events: List[int] = []
+        j = 0
+        for pos in range(self._n_cols):
+            e = int(self._col_events[pos])
+            if e < 0:
+                continue
+            if e >= d and int(self._rnd_w[e]) >= self._r_base:
+                keep[j] = pos
+                kept_events.append(e - d)
+                j += 1
+        self._anc_d, self._sees_d, self._ssm_d = obs.stage_call(
+            "pipeline.inc_prune", prune_stage,
+            self._anc_d, self._sees_d, self._ssm_d,
+            np.int32(d), np.int32(w_used), jnp.asarray(keep),
+        )
+        # host mirrors
+        w2 = w_used - d
+        pw = self._parents_w[d:w_used]
+        self._parents_w[:w2] = np.where(pw >= d, pw - d, -1)
+        self._parents_w[w2:] = -1
+
+        def roll1(a, fill):
+            a[:w2] = a[d:w_used]
+            a[w2:] = fill
+
+        roll1(self._creator_w, 0)
+        roll1(self._coin_w, 0)
+        roll1(self._t_w, 0)
+        roll1(self._rnd_w, 0)
+        roll1(self._wits_w, False)
+        roll1(self._recv_w, False)
+        self._recompute_depth(w2)
+        # member table + fork pairs + witness table entries shift by d
+        self._mt_np[:] = -1
+        self._mcount[:] = 0
+        for i in range(w2):
+            m = int(self._creator_w[i])
+            self._mt_np[m, self._mcount[m]] = i
+            self._mcount[m] += 1
+        if self._fork_np.shape[0]:
+            self._fork_np = np.stack(
+                [self._fork_np[:, 0], self._fork_np[:, 1] - d,
+                 self._fork_np[:, 2] - d], axis=1,
+            )
+        tv = self._tab_np >= 0
+        self._tab_np = np.where(tv, self._tab_np - d, -1)
+        # rebuilt column store positions
+        self._colpos_w[:] = -1
+        ce = np.full((self._wcol_cap,), -1, np.int32)
+        for jj, e in enumerate(kept_events):
+            ce[jj] = e
+            self._colpos_w[e] = jj
+        self._col_events = ce
+        self._n_cols = len(kept_events)
+        self._lo += d
+        # per-member slab regather (k-slot positions shifted)
+        self._a3_d, self._b3_d = obs.stage_call(
+            "pipeline.member_slabs", member_slabs,
+            self._sees_d, jnp.asarray(self._mt_np),
+        )
+
+    # ------------------------------------------------------------ rebase
+
+    def _rebase(self) -> List[int]:
+        """Full-recompute fallback: run the batch columns pipeline over the
+        whole packed DAG, commit its outputs, and lift the device
+        intermediates into fresh carried-window state (then prune)."""
+        packed = self.packer.pack()
+        n = packed.n
+        prev_ordered = len(self._order)
+        # witness-slot capacity must match the window table (monotone)
+        extras = (
+            len(set(packed.fork_pairs[:, 2].tolist()))
+            if len(packed.fork_pairs)
+            else 0
+        )
+        self._s_cap = max(self._s_cap, self._m + extras + 1)
+        arrays, statics, ts_unique = prepare_inputs(
+            packed, self.config, block=self._block, s_max=self._s_cap,
+            matmul_dtype_name=self._mm,
+        )
+        chain = statics["chain"]
+        r_rounds = min(statics["r_max"], _bucket(chain + 1, 32))
+        out, aux = _columns_pass(
+            packed, self.config, arrays["parents"], arrays["creator"],
+            arrays["t_rank"], arrays["coin"], arrays["stake"],
+            arrays["member_table"],
+            n=n, tot=self._tot, block=self._block, r_rounds=r_rounds,
+            s_max=self._s_cap, chain=chain, matmul_dtype_name=self._mm,
+            ssm_cols_fn=self._ssm_cols_fn,
+        )
+        result = finalize_order(packed, out, ts_unique)
+
+        # ---- commit everything the batch pass decided
+        self._grow_global(n)
+        self._round_g[:n] = out["round"][:n]
+        self._wits_g[:n] = out["is_witness"][:n]
+        self._rr_g[:n] = result.round_received
+        self._cts_g[:n] = result.consensus_ts
+        self._order = list(result.order)
+        self._max_round = int(out["max_round"])
+        self._n_done = n
+        self._g_done = packed.fork_pairs.shape[0]
+        tabf = out["wit_table"]
+        r_tight = tabf.shape[0]
+        fam = out["famous"].reshape(r_tight, self._s_cap)
+        dec = out["fame_decided_at"].reshape(r_tight, self._s_cap)
+        cntf = out["wit_count"]
+        cr = 0
+        while cr < r_tight:
+            valid = tabf[cr] >= 0
+            if cntf[cr] <= 0 or self._max_round < cr + 2:
+                break
+            if (fam[cr][valid] < 0).any():
+                break
+            cr += 1
+        self._consensus_round = cr
+        self._famous_committed = {}
+        fv = 0
+        for r in range(cr):
+            for s in range(self._s_cap):
+                e = int(tabf[r, s])
+                if e < 0:
+                    continue
+                self._famous_committed[e] = bool(fam[r, s] == 1)
+                fv = max(fv, int(dec[r, s]))
+        self._frozen_vote_hi = fv
+
+        # ---- choose the pruned boundary and lift the window
+        received = result.round_received >= 0
+        nr = ~received
+        lo = int(np.argmax(nr)) if nr.any() else n
+        if packed.fork_pairs.shape[0]:
+            lo = min(lo, int(packed.fork_pairs[:, 1:].min()))
+        self._lo = lo
+        self._r_base = cr
+        w_used = n - lo
+        self._w_pad = max(
+            self._w_pad,
+            _bucket(w_used + 2 * self._chunk, self._window_bucket),
+        )
+        r_need = self._max_round - cr + 16
+        if r_need > self._r_cap:
+            self._r_cap = _bucket(r_need, 16)
+        w_pad = self._w_pad
+        self._alloc_mirrors(w_pad)
+        pg = packed.parents[lo:n].astype(np.int32)
+        self._parents_w[:w_used] = np.where(pg >= lo, pg - lo, -1)
+        self._creator_w[:w_used] = packed.creator[lo:n]
+        self._coin_w[:w_used] = packed.coin[lo:n]
+        self._t_w[:w_used] = packed.t[lo:n]
+        self._rnd_w[:w_used] = out["round"][lo:n]
+        self._wits_w[:w_used] = out["is_witness"][lo:n]
+        self._recv_w[:w_used] = received[lo:]
+        self._recompute_depth(w_used)
+        # member table over the window
+        self._mcount = np.zeros((self._m,), np.int32)
+        counts = np.bincount(packed.creator[lo:n], minlength=self._m)
+        if int(counts.max(initial=0)) > self._k_cap:
+            self._k_cap = _bucket(int(counts.max()) + 4, 8)
+        self._mt_np = np.full((self._m, self._k_cap), -1, np.int32)
+        for i in range(w_used):
+            m = int(self._creator_w[i])
+            self._mt_np[m, self._mcount[m]] = i
+            self._mcount[m] += 1
+        # fork pairs, window-remapped (all members >= lo by the cap above)
+        if packed.fork_pairs.shape[0]:
+            fp = packed.fork_pairs.astype(np.int32)
+            self._fork_np = np.stack(
+                [fp[:, 0], fp[:, 1] - lo, fp[:, 2] - lo], axis=1
+            )
+        else:
+            self._fork_np = np.zeros((0, 3), np.int32)
+        # witness table rows [cr, cr + r_cap), entries window-remapped
+        self._tab_np = np.full((self._r_cap, self._s_cap), -1, np.int32)
+        self._cnt_np = np.zeros((self._r_cap,), np.int32)
+        self._famous_np = np.full((self._r_cap, self._s_cap), -1, np.int8)
+        self._dec_np = np.full((self._r_cap, self._s_cap), -1, np.int32)
+        hi = min(r_tight, cr + self._r_cap)
+        rows = hi - cr
+        if rows > 0:
+            tw = tabf[cr:hi].astype(np.int32)
+            self._tab_np[:rows] = np.where(tw >= 0, tw - lo, -1)
+            self._cnt_np[:rows] = cntf[cr:hi]
+            self._famous_np[:rows] = fam[cr:hi]
+            self._dec_np[:rows] = dec[cr:hi]
+        # column store: keep retained-round witness columns
+        bat_pos = aux["col_pos"]
+        bat_ssm = np.asarray(aux["ssm_c"])
+        kept = [
+            (e, int(bat_pos[e]))
+            for e in range(lo, n)
+            if bat_pos[e] >= 0 and int(out["round"][e]) >= cr
+            and bool(out["is_witness"][e])
+        ]
+        n_cols = len(kept)
+        self._wcol_cap = max(self._wcol_cap, _bucket(n_cols + 128, 256))
+        ssm_w = np.zeros((w_pad, self._wcol_cap), bool)
+        self._col_events = np.full((self._wcol_cap,), -1, np.int32)
+        if kept:
+            pos_list = [pos for _e, pos in kept]
+            ssm_w[:w_used, :n_cols] = bat_ssm[lo:n][:, pos_list]
+            for j, (e, _pos) in enumerate(kept):
+                self._col_events[j] = e - lo
+                self._colpos_w[e - lo] = j
+        self._n_cols = n_cols
+        # visibility slabs, window-sliced
+        bat_anc = np.asarray(aux["anc"])
+        bat_sees = np.asarray(aux["sees"])
+        anc_w = np.zeros((w_pad, w_pad), bool)
+        anc_w[:w_used, :w_used] = bat_anc[lo:n, lo:n]
+        sees_w = np.zeros((w_pad, w_pad), bool)
+        sees_w[:w_used, :w_used] = bat_sees[lo:n, lo:n]
+        self._anc_d = jnp.asarray(anc_w)
+        self._sees_d = jnp.asarray(sees_w)
+        self._ssm_d = jnp.asarray(ssm_w)
+        self._a3_d, self._b3_d = obs.stage_call(
+            "pipeline.member_slabs", member_slabs,
+            self._sees_d, jnp.asarray(self._mt_np),
+        )
+        self._initialized = True
+        return self._order[prev_ordered:]
