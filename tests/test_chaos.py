@@ -1,0 +1,313 @@
+"""Chaos harness: the acceptance scenario, crash-recovery fidelity, the
+rebase-storm guard, and checkpoint handoff into the incremental pipeline.
+
+The smoke scenario here is the PR's acceptance gate: >=20% drop,
+reordering, one partition + heal, one crash + checkpoint-restart, one
+equivocating forker — completing with every honest node's decided order
+bit-identical to a prefix of the fault-free oracle replay, decided rounds
+advancing after heal, and zero uncaught exceptions (the run finishing IS
+the assertion; nothing in the gossip path may raise on peer behavior).
+"""
+
+import dataclasses
+
+import pytest
+
+from tpu_swirld import obs as obslib
+from tpu_swirld.chaos import ChaosScenario, ChaosSimulation
+from tpu_swirld.checkpoint import load_node, load_packed, save_node, save_packed
+from tpu_swirld.config import SwirldConfig
+from tpu_swirld.oracle.event import Event
+from tpu_swirld.packing import pack_events
+from tpu_swirld.sim import generate_gossip_dag
+from tpu_swirld.tpu.pipeline import IncrementalConsensus, run_consensus
+from tpu_swirld.transport import FaultPlan, LinkFaults, Partition
+
+from tests.test_incremental import assert_same_result
+
+
+def _acceptance_scenario(seed=3):
+    plan = FaultPlan(
+        seed=seed,
+        default=LinkFaults(
+            drop=0.2, corrupt=0.05, duplicate=0.05, reorder=0.1, delay=0.05,
+        ),
+        partitions=[Partition(start=80, end=140, group=(0, 1))],
+        crashes={4: [(60, 120)]},
+    )
+    return ChaosScenario(
+        n_nodes=5, n_turns=240, seed=seed, n_forkers=1, plan=plan,
+        checkpoint_every=40,
+    )
+
+
+@pytest.mark.chaos
+def test_chaos_smoke_acceptance_scenario(tmp_path):
+    v = ChaosSimulation(_acceptance_scenario(), str(tmp_path)).run()
+    # safety: bit-identical decided prefixes, equal to the oracle replay
+    assert v["safety"]["prefix_agree"], v
+    assert v["safety"]["oracle_agree"], v
+    assert v["safety"]["common_prefix_len"] > 0
+    # liveness: decided rounds advanced after the partition healed and the
+    # crashed node restarted from its checkpoint
+    assert v["liveness"]["advanced_after_heal"], v
+    # every scheduled fault class actually fired
+    f = v["faults"]
+    assert f["drops"] > 0 and f["partition_blocked"] > 0
+    assert f["crash_blocked"] > 0 and f["reorders"] > 0
+    r = v["resilience"]
+    assert r["crashes"] == 1 and r["restarts"] == 1
+    assert r["retries"] > 0 and r["backoff_total"] > 0
+    assert r["forks_detected"] >= 1       # the equivocator was caught
+    assert v["ok"], v
+
+
+@pytest.mark.chaos
+def test_chaos_run_reproducible_from_seeds(tmp_path):
+    """The whole verdict — fault counts included — replays from seeds."""
+    v1 = ChaosSimulation(_acceptance_scenario(), str(tmp_path / "a")).run()
+    v2 = ChaosSimulation(_acceptance_scenario(), str(tmp_path / "b")).run()
+    assert v1 == v2
+
+
+@pytest.mark.chaos
+def test_chaos_crash_restart_reconverges_bit_identical(tmp_path):
+    """The restarted node's decided order must be byte-equal to a prefix
+    of the never-crashed nodes' — restore + gossip replay is exact."""
+    sim = ChaosSimulation(_acceptance_scenario(seed=8), str(tmp_path))
+    v = sim.run()
+    assert v["ok"], v
+    crashed = sim.nodes[4]
+    survivor = sim.nodes[2]
+    k = min(len(crashed.consensus), len(survivor.consensus))
+    assert k > 0
+    assert crashed.consensus[:k] == survivor.consensus[:k]
+
+
+def test_chaos_whole_cluster_outage_is_dead_air_not_crash(tmp_path):
+    """Overlapping crash windows covering every honest member must play
+    out as dead-air turns, not a mid-run exception."""
+    sc = ChaosScenario(
+        n_nodes=2, n_turns=80, seed=1,
+        plan=FaultPlan(crashes={0: [(5, 20)], 1: [(5, 20)]}),
+        checkpoint_every=4,
+    )
+    v = ChaosSimulation(sc, str(tmp_path)).run()
+    assert v["resilience"]["crashes"] == 2
+    assert v["resilience"]["restarts"] == 2
+    assert v["safety"]["prefix_agree"]
+
+
+def test_chaos_scenario_validation(tmp_path):
+    bad = ChaosScenario(
+        n_nodes=4, n_turns=50, seed=0,
+        plan=FaultPlan(partitions=[Partition(start=10, end=60, group=(0,))]),
+    )
+    with pytest.raises(ValueError):
+        ChaosSimulation(bad, str(tmp_path))
+    bad2 = ChaosScenario(
+        n_nodes=4, n_turns=50, seed=0, plan=FaultPlan(crashes={1: [(0, 10)]})
+    )
+    with pytest.raises(ValueError):
+        ChaosSimulation(bad2, str(tmp_path))
+
+
+@pytest.mark.chaos
+def test_forking_adversary_rides_faulty_transport():
+    """Byzantine fork injection and network faults compose through one
+    transport: the sim helpers accept a faulty transport_factory."""
+    from tpu_swirld.sim import run_with_forkers
+    from tpu_swirld.transport import FaultyTransport
+
+    def factory(network, network_want, members, clock):
+        return FaultyTransport(
+            network, network_want,
+            FaultPlan(seed=5, default=LinkFaults(drop=0.15, reorder=0.1)),
+            members, clock,
+        )
+
+    sim = run_with_forkers(
+        5, 1, 220, seed=5, fork_every=6, transport_factory=factory
+    )
+    assert sim.transport.stats["drops"] > 0
+    forker_pk = sim.nodes[0].pk
+    assert any(n.has_fork[forker_pk] for n in sim.nodes)
+    orders = [n.consensus for n in sim.nodes]
+    m = min(len(o) for o in orders)
+    assert m > 0 and all(o[:m] == orders[0][:m] for o in orders)
+
+
+@pytest.mark.smoke
+@pytest.mark.chaos
+def test_chaos_run_cli_smoke(tmp_path):
+    """scripts/chaos_run.py: seeded run -> JSON verdict artifact + trace,
+    exit 0 on an ok verdict, and the report CLI renders the resilience
+    section from the emitted trace."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    out = tmp_path / "verdict.json"
+    r = subprocess.run(
+        [
+            sys.executable, "scripts/chaos_run.py",
+            "--seed", "3", "--plan-seed", "3", "--nodes", "5",
+            "--turns", "240", "--forkers", "1",
+            "--out", str(out),
+        ],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    v = json.loads(out.read_text())
+    assert v["ok"] and v["safety"]["oracle_agree"]
+    trace = tmp_path / "verdict.trace.jsonl"
+    assert trace.exists()
+    r2 = subprocess.run(
+        [sys.executable, "-m", "tpu_swirld.obs", "report", str(trace)],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert "resilience" in r2.stdout
+    assert "transport_drops_total" in r2.stdout
+
+
+# ------------------------------------------------------ rebase-storm guard
+
+
+def _straggler_flood(n_events=600, n_floods=8, seed=6):
+    """A decided-and-pruned main stream followed by a flood of ancient
+    fork leaves: every flood event names long-pruned parents, so each
+    un-guarded ingest pays a detected rebase."""
+    members, stake, events, keys = generate_gossip_dag(8, n_events, seed=seed)
+    by_creator = {}
+    for ev in events:
+        by_creator.setdefault(ev.c, []).append(ev)
+    floods = []
+    for k in range(n_floods):
+        ci = k % 8
+        pk, sk = keys[ci]
+        old_self = by_creator[pk][2 + (k % 3)]
+        old_other = by_creator[members[(ci + 1) % 8]][2]
+        floods.append(
+            Event(
+                d=b"straggler:%d" % k, p=(old_self.id, old_other.id),
+                t=old_self.t + 1, c=pk,
+            ).signed(sk)
+        )
+    return members, stake, events, floods
+
+
+def _drive_flood(members, stake, events, floods, **kw):
+    cfg = SwirldConfig(n_members=len(members))
+    inc = IncrementalConsensus(
+        members, stake, cfg, block=64, chunk=64, window_bucket=256,
+        prune_min=64, **kw,
+    )
+    for i in range(0, len(events), 100):
+        inc.ingest(events[i : i + 100])
+    for f in floods:
+        inc.ingest(f if isinstance(f, list) else [f])
+    return inc
+
+
+def test_rebase_storm_guard_caps_consecutive_rebases():
+    members, stake, events, floods = _straggler_flood()
+    # control: guard disabled — the flood thrashes one rebase per pass
+    control = _drive_flood(members, stake, events, floods, storm_threshold=0)
+    assert control.max_consecutive_rebases >= len(floods) - 1
+    assert control.storm_entries == 0
+    # guarded: consecutive *detected* rebases are capped at the threshold;
+    # the guard then holds full-recompute mode through the cooldown
+    with obslib.enabled() as o:
+        guarded = _drive_flood(
+            members, stake, events, floods, storm_threshold=3, storm_cooldown=4
+        )
+    assert guarded.max_consecutive_rebases <= 3
+    assert guarded.storm_entries >= 1
+    assert guarded.storm_rebases >= 1
+    # the fallback decision is visible in the obs gauges
+    reg = o.registry
+    assert reg.value("incremental_storm_rebases_total") == guarded.storm_rebases
+    assert reg.value("incremental_storm_mode") is not None
+    assert reg.value("incremental_consecutive_rebases") is not None
+    # and the guard never bends the exactness contract
+    cfg = SwirldConfig(n_members=len(members))
+    delivery = list(events) + list(floods)
+    ref = run_consensus(pack_events(delivery, members, stake), cfg, block=64)
+    assert_same_result(guarded.result(), ref)
+    assert_same_result(control.result(), ref)
+
+
+def test_storm_guard_exits_after_cooldown_on_clean_traffic():
+    """Hysteresis: once the flood stops, the cooldown drains and clean
+    incremental passes resume (storm mode must not latch forever)."""
+    members, stake, events, floods = _straggler_flood(n_floods=4)
+    cfg = SwirldConfig(n_members=len(members))
+    inc = IncrementalConsensus(
+        members, stake, cfg, block=64, chunk=64, window_bucket=256,
+        prune_min=64, storm_threshold=2, storm_cooldown=2,
+    )
+    for i in range(0, 500, 100):
+        inc.ingest(events[i : i + 100])
+    for f in floods:
+        inc.ingest([f])
+    assert inc.storm_entries >= 1
+    # clean tail traffic: the remaining honest events, small chunks
+    stats = None
+    for i in range(500, len(events), 25):
+        stats = inc.ingest(events[i : i + 25])
+    assert stats is not None and not stats["storm_mode"]
+    assert not stats["rebased"]        # incremental path re-admitted
+    delivery = events[:500] + floods + events[500:]
+    ref = run_consensus(pack_events(delivery, members, stake), cfg, block=64)
+    assert_same_result(inc.result(), ref)
+
+
+# --------------------------------------------- checkpoint handoff fidelity
+
+
+def test_checkpoint_packed_roundtrip_into_incremental_pipeline(tmp_path):
+    """save_packed/load_packed must hand the incremental driver's packed
+    state to a cold batch pass bit-identically (crash-recovery for the
+    device pipeline: restore the packed DAG, recompute, same outputs)."""
+    members, stake, events, _keys = generate_gossip_dag(6, 400, seed=9)
+    cfg = SwirldConfig(n_members=6)
+    inc = IncrementalConsensus(
+        members, stake, cfg, block=64, chunk=64, window_bucket=256,
+        prune_min=64,
+    )
+    for i in range(0, len(events), 80):
+        inc.ingest(events[i : i + 80])
+    path = str(tmp_path / "inc.npz")
+    save_packed(path, inc.packer.pack())
+    restored = load_packed(path)
+    assert_same_result(inc.result(), run_consensus(restored, cfg, block=64))
+
+
+def test_checkpoint_node_restore_preserves_resilience_surface(tmp_path):
+    """load_node must come back with the full transport stack attached:
+    breaker, retry policy, and the transport it is handed."""
+    from tpu_swirld.sim import make_simulation
+    from tpu_swirld.transport import Transport
+
+    sim = make_simulation(3, seed=21)
+    sim.run(60)
+    node = sim.nodes[0]
+    path = str(tmp_path / "n.swck")
+    save_node(path, node)
+    transport = Transport(sim.network, {})
+    restored = load_node(
+        path, sk=node.sk, pk=node.pk, network=sim.network,
+        transport=transport,
+    )
+    assert restored.consensus == node.consensus
+    assert restored.transport is transport
+    assert restored.breaker is not None
+    assert restored.retry_policy.attempts == node.retry_policy.attempts
+    got = restored.pull(sim.nodes[1].pk)
+    restored.consensus_pass(got)
+    m = min(len(restored.consensus), len(sim.nodes[1].consensus))
+    assert restored.consensus[:m] == sim.nodes[1].consensus[:m]

@@ -215,12 +215,14 @@ def test_malformed_wire_blobs_rejected():
         with pytest.raises(MalformedEvent):
             decode_event(bad)
     # a corrupted blob inside a signed sync reply fails signature first;
-    # a *validly signed* malformed blob must raise cleanly, not crash
+    # a *validly signed* malformed blob must degrade to a counted
+    # rejection (None + bad_replies), never an uncaught exception
     from tpu_swirld import crypto
     evil = blob[:-1]
     reply = evil + crypto.sign(evil, skA, crypto.DOMAIN_SYNC_REPLY)
-    with pytest.raises(ValueError):
-        node._decode_signed_blob(reply, pkA)
+    before = node.bad_replies
+    assert node._decode_signed_blob(reply, pkA) is None
+    assert node.bad_replies == before + 1
 
 
 def test_domain_separation():

@@ -11,8 +11,10 @@ import dataclasses
 
 import pytest
 
+from tpu_swirld.chaos import ChaosScenario, ChaosSimulation
 from tpu_swirld.checkpoint import load_node
 from tpu_swirld.sim import run_with_divergent_forkers
+from tpu_swirld.transport import FaultPlan, LinkFaults, Partition
 
 
 @pytest.mark.slow
@@ -64,3 +66,36 @@ def test_mixed_backend_byzantine_soak(tmp_path):
     restored.consensus_pass(got)
     mm = min(len(restored.consensus), len(honest[1].consensus))
     assert restored.consensus[:mm] == honest[1].consensus[:mm]
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_soak_mixed_backend_heavy_faults(tmp_path):
+    """Long chaos soak: two equivocators, a tpu-backend honest node, two
+    partitions, two staggered crash/restart cycles, heavy loss — the
+    safety and liveness invariants must hold end to end."""
+    plan = FaultPlan(
+        seed=11,
+        default=LinkFaults(
+            drop=0.25, corrupt=0.08, duplicate=0.08, reorder=0.15, delay=0.08,
+        ),
+        partitions=[
+            Partition(start=200, end=320, group=(2, 3)),
+            Partition(start=460, end=540, group=(4, 5)),
+        ],
+        crashes={5: [(150, 260)], 6: [(400, 520)]},
+    )
+    scenario = ChaosScenario(
+        n_nodes=8, n_turns=700, seed=11, n_forkers=2, plan=plan,
+        checkpoint_every=60, tpu_node_index=7,
+    )
+    sim = ChaosSimulation(scenario, str(tmp_path))
+    v = sim.run()
+    assert v["ok"], v
+    assert v["resilience"]["crashes"] == 2
+    assert v["resilience"]["restarts"] == 2
+    assert v["resilience"]["forks_detected"] >= 1
+    assert v["faults"]["drops"] > 0 and v["faults"]["partition_blocked"] > 0
+    tpu_node = sim.nodes[7]
+    assert tpu_node._tpu_engine is not None, "device engine must have run"
+    assert len(tpu_node.consensus) > 0

@@ -1,0 +1,432 @@
+"""Transport layer: fault injection, retry/backoff, circuit breaker, and
+malformed-payload hardening.
+
+Everything here is deterministic and sleep-free: fault streams come from
+``Random(plan.seed)``, backoff delays are *recorded* (logical time), and
+the circuit breaker runs against a fake clock the test advances by hand.
+"""
+
+import dataclasses
+import random
+
+import pytest
+
+from tpu_swirld import crypto
+from tpu_swirld.config import SwirldConfig
+from tpu_swirld.metrics import Metrics
+from tpu_swirld.sim import make_simulation, run_with_divergent_forkers
+from tpu_swirld.transport import (
+    CircuitBreaker,
+    FaultPlan,
+    FaultyTransport,
+    LinkFaults,
+    MessageDropped,
+    Partition,
+    PeerPartitioned,
+    PeerUnreachable,
+    RetryPolicy,
+    Transport,
+    TransportError,
+)
+
+A, B, C = b"A" * 32, b"B" * 32, b"C" * 32
+
+
+def _echo_net():
+    return {pk: (lambda src, req, _pk=pk: b"reply-from-" + _pk) for pk in (A, B, C)}
+
+
+# ------------------------------------------------------------- base layer
+
+
+def test_direct_transport_passthrough_and_unknown_peer():
+    t = Transport(_echo_net(), {})
+    assert t.call(B, A, "sync", b"x") == b"reply-from-" + A
+    with pytest.raises(PeerUnreachable):
+        t.call(A, b"Z" * 32, "sync", b"x")
+    with pytest.raises(PeerUnreachable):
+        t.call(A, B, "want", b"x")   # no want endpoint registered
+
+
+def test_faulty_transport_is_seed_deterministic():
+    def run(seed):
+        ft = FaultyTransport(
+            _echo_net(), {},
+            FaultPlan(seed=seed, default=LinkFaults(
+                drop=0.3, corrupt=0.2, duplicate=0.1, reorder=0.2, delay=0.1,
+            )),
+            [A, B, C], clock=lambda: 0,
+        )
+        out = []
+        for i in range(300):
+            try:
+                out.append(ft.call(A, B, "sync", b"p%d" % i))
+            except TransportError as e:
+                out.append(type(e).__name__)
+        return out, dict(ft.stats)
+
+    assert run(5) == run(5)
+    assert run(5)[0] != run(6)[0]
+    # every fault class actually fired at these probabilities
+    _, stats = run(5)
+    for k in ("drops", "corruptions", "duplicates", "reorders", "delays"):
+        assert stats[k] > 0, (k, stats)
+
+
+def test_partition_window_cuts_cross_group_links_only():
+    t = [0]
+    ft = FaultyTransport(
+        _echo_net(), {},
+        FaultPlan(partitions=[Partition(start=10, end=20, group=(0, 1))]),
+        [A, B, C], clock=lambda: t[0],
+    )
+    assert ft.call(A, C, "sync", b"x")       # before the window
+    t[0] = 10
+    assert ft.call(A, B, "sync", b"x")       # same side of the cut
+    with pytest.raises(PeerPartitioned):
+        ft.call(A, C, "sync", b"x")          # crosses the cut
+    with pytest.raises(PeerPartitioned):
+        ft.call(C, B, "sync", b"x")
+    t[0] = 20
+    assert ft.call(A, C, "sync", b"x")       # healed
+    assert ft.stats["partition_blocked"] == 2
+
+
+def test_crashed_peer_is_unreachable_until_restart():
+    ft = FaultyTransport(
+        _echo_net(), {}, FaultPlan(), [A, B, C], clock=lambda: 0
+    )
+    ft.set_down(B)
+    with pytest.raises(PeerUnreachable):
+        ft.call(A, B, "sync", b"x")
+    with pytest.raises(PeerUnreachable):
+        ft.call(B, A, "sync", b"x")          # a dead node can't call out
+    ft.set_up(B)
+    assert ft.call(A, B, "sync", b"x")
+    assert ft.stats["crash_blocked"] == 2
+
+
+def test_corruption_mangles_but_never_crashes():
+    ft = FaultyTransport(
+        _echo_net(), {},
+        FaultPlan(seed=1, default=LinkFaults(corrupt=1.0)),
+        [A, B], clock=lambda: 0,
+    )
+    for i in range(100):
+        out = ft.call(A, B, "sync", b"payload")
+        assert isinstance(out, bytes)
+    assert ft.stats["corruptions"] >= 100    # request and/or reply mangled
+
+
+def test_duplicates_and_delays_surface_without_reorder_knob():
+    """Stashed stale replies must drain even when reorder=0 — otherwise
+    duplicate/delay faults are silently inert."""
+    ft = FaultyTransport(
+        _echo_net(), {},
+        FaultPlan(seed=2, default=LinkFaults(duplicate=0.4)),
+        [A, B], clock=lambda: 0,
+    )
+    for _ in range(120):
+        ft.call(A, B, "sync", b"p")
+    assert ft.stats["duplicates"] > 0
+    assert ft.stats["reorders"] > 0    # stale deliveries actually happened
+
+
+# ---------------------------------------------------------- retry policy
+
+
+def test_retry_policy_exponential_capped_backoff():
+    pol = RetryPolicy(attempts=5, backoff_base=1.0, backoff_cap=8.0, jitter=0.0)
+    rng = random.Random(0)
+    assert [pol.backoff(i, rng) for i in range(5)] == [1.0, 2.0, 4.0, 8.0, 8.0]
+    jpol = dataclasses.replace(pol, jitter=0.5)
+    for i in range(5):
+        d = jpol.backoff(i, rng)
+        base = min(8.0, 2.0 ** i)
+        assert base <= d <= base * 1.5
+
+
+class FlakyTransport(Transport):
+    """Fails the first ``fail_first`` calls, then delivers reliably."""
+
+    def __init__(self, network, network_want, fail_first=0):
+        super().__init__(network, network_want)
+        self.fail_first = fail_first
+        self.calls = 0
+
+    def call(self, src, dst, channel, payload):
+        self.calls += 1
+        if self.calls <= self.fail_first:
+            raise MessageDropped("flaky")
+        return super().call(src, dst, channel, payload)
+
+
+def _flaky_sim(fail_first, **cfg_kw):
+    holder = {}
+
+    def factory(network, network_want, members, clock):
+        holder["ft"] = FlakyTransport(network, network_want, fail_first)
+        return holder["ft"]
+
+    config = SwirldConfig(n_members=3, retry_jitter=0.0, **cfg_kw)
+    sim = make_simulation(
+        3, seed=0, config=config, metrics=True, transport_factory=factory
+    )
+    return sim, holder["ft"]
+
+
+def test_pull_retries_with_recorded_backoff_no_sleeps():
+    sim, ft = _flaky_sim(0, retry_attempts=4)
+    sim.run(6)                         # build up some history reliably
+    ft.calls, ft.fail_first = 0, 2     # next two transport calls fail
+    node, peer = sim.nodes[0], sim.nodes[1].pk
+    delays = []
+    node._sleep = delays.append
+    got = node.pull(peer)
+    assert got is not None             # succeeded on the 3rd attempt
+    assert ft.calls == 3
+    assert node.retries == 2
+    assert delays == [1.0, 2.0]        # exponential, jitter-free, logical
+    assert node.backoff_total == 3.0   # accumulated on success paths too
+    assert node.metrics.counts["gossip_retries"] == 2
+    assert node.metrics.counts["gossip_transport_errors"] == 2
+    assert node.metrics.registry.value("gossip_backoff_time") == 3.0
+
+
+def test_pull_gives_up_at_deadline_without_raising():
+    sim, ft = _flaky_sim(0, retry_attempts=6, retry_deadline=2.5)
+    sim.run(4)
+    ft.calls, ft.fail_first = 0, 10**9   # never recovers
+    node, peer = sim.nodes[0], sim.nodes[1].pk
+    assert node.pull(peer) == []
+    # backoff 1 + 2 = 3 would exceed the 2.5 deadline at the 2nd retry
+    assert ft.calls == 2
+    assert node.metrics.counts["gossip_deadline_exceeded"] == 1
+    assert node.backoff_total == 1.0
+
+
+# -------------------------------------------------------- circuit breaker
+
+
+def test_circuit_breaker_open_halfopen_close_transitions():
+    t = [0]
+    br = CircuitBreaker(
+        clock=lambda: t[0], failure_threshold=3,
+        misbehavior_threshold=4, cooldown=10.0,
+    )
+    peer = b"P" * 32
+    assert br.allow(peer)
+    br.record_failure(peer)
+    br.record_failure(peer)
+    assert br.allow(peer)              # below threshold: still closed
+    br.record_failure(peer)            # third strike: open
+    assert br.opens == 1
+    assert not br.allow(peer)
+    assert br.quarantined() == [peer]
+    t[0] = 9
+    assert not br.allow(peer)          # cooldown not elapsed
+    t[0] = 10
+    assert br.allow(peer)              # half-open: one probe admitted
+    br.record_failure(peer)            # probe failed: re-open, new cooldown
+    assert br.opens == 2
+    assert not br.allow(peer)
+    t[0] = 25
+    assert br.allow(peer)              # probe again
+    br.record_success(peer)            # probe succeeded: closed
+    assert br.allow(peer)
+    assert br.quarantined() == []
+    # misbehavior strikes open independently of transport failures
+    for _ in range(4):
+        br.record_misbehavior(peer)
+    assert br.opens == 3 and not br.allow(peer)
+    # success while fully open must NOT close the circuit
+    br.record_success(peer)
+    assert not br.allow(peer) or t[0] != 25
+
+
+def test_misbehavior_strikes_decay_on_clean_replies():
+    """Occasional in-flight corruption (counted as misbehavior at decode)
+    must not slowly quarantine an honest peer: one clean reply pays down
+    one strike."""
+    br = CircuitBreaker(
+        clock=lambda: 0, failure_threshold=3,
+        misbehavior_threshold=4, cooldown=10.0,
+    )
+    peer = b"Q" * 32
+    for _ in range(40):                # 8% corruption-style interleaving
+        br.record_misbehavior(peer)
+        br.record_success(peer)
+        br.record_success(peer)
+    assert br.allow(peer) and br.opens == 0
+    # a peer serving mostly garbage still out-runs the decay
+    for _ in range(8):
+        br.record_misbehavior(peer)
+    assert br.opens == 1 and not br.allow(peer)
+
+
+def test_node_fastfails_quarantined_peer_then_recovers():
+    sim, ft = _flaky_sim(
+        0, retry_attempts=1, breaker_failures=2, breaker_cooldown=5.0
+    )
+    sim.run(4)
+    node, peer = sim.nodes[0], sim.nodes[1].pk
+    ft.calls, ft.fail_first = 0, 2
+    assert node.pull(peer) == []       # failure 1
+    assert node.pull(peer) == []       # failure 2: breaker opens
+    assert node.circuit_opens == 1 and node.quarantined_peers == 1
+    calls_before = ft.calls
+    assert node.pull(peer) == []       # fast-fail: no transport traffic
+    assert ft.calls == calls_before
+    assert node.metrics.counts["gossip_circuit_fastfail"] == 1
+    sim.clock[0] += 5                  # cooldown elapses (logical clock)
+    got = node.pull(peer)              # half-open probe, transport healed
+    assert got is not None and node.quarantined_peers == 0
+
+
+def test_fork_detection_feeds_breaker_when_quarantine_enabled():
+    sim = run_with_divergent_forkers(
+        5, 1, 80, seed=2, fork_every=2,
+        node_config=lambda i, base: dataclasses.replace(
+            base, quarantine_forkers=True
+        ),
+    )
+    forker_pk = sim.forkers[0].pk
+    detecting = [n for n in sim.nodes if n.has_fork[forker_pk]]
+    assert detecting, "fork must have been detected"
+    assert any(forker_pk in n.breaker.quarantined() for n in detecting)
+    # honest members never quarantine each other over forks
+    honest_pks = {n.pk for n in sim.nodes}
+    for n in sim.nodes:
+        assert not honest_pks & set(n.breaker.quarantined())
+
+
+# ------------------------------------------------- payload hardening
+
+
+def test_ask_events_rejects_garbage_with_signed_empty_reply():
+    sim = make_simulation(2, seed=4)
+    sim.run(12)
+    asker, server = sim.nodes[0], sim.nodes[1]
+    for junk in (b"", b"xx", b"\x00" * 100, b"\xff" * (crypto.SIG_BYTES + 7)):
+        before = server.bad_requests
+        reply = server.ask_events(asker.pk, junk)
+        assert server.bad_requests == before + 1
+        events = asker._decode_signed_blob(reply, server.pk)
+        assert events == []            # decodes cleanly to zero events
+    # a want-list whose payload length is not a hash multiple
+    bad = b"\x01" * 33
+    req = bad + crypto.sign(bad, asker.sk, crypto.DOMAIN_WANT)
+    before = server.bad_requests
+    assert asker._decode_signed_blob(
+        server.ask_events(asker.pk, req), server.pk
+    ) == []
+    assert server.bad_requests == before + 1
+    # unknown peers are a config error, not payload-dependent: still raise
+    with pytest.raises(ValueError):
+        server.ask_events(b"Z" * 32, b"anything")
+
+
+def test_ask_sync_counts_truncated_and_oversized_requests():
+    sim = make_simulation(2, seed=4)
+    sim.run(6)
+    server = sim.nodes[1]
+    for junk in (b"", b"short", b"\x00" * (server.config.max_reply_bytes + 1)):
+        with pytest.raises(ValueError):
+            server.ask_sync(sim.nodes[0].pk, junk)
+    assert server.bad_requests == 3
+
+
+def test_decode_signed_blob_counted_rejection_paths():
+    sim = make_simulation(2, seed=9)
+    sim.run(10)
+    node, peer = sim.nodes[0], sim.nodes[1]
+    cases = [
+        b"",                                           # shorter than a sig
+        b"\x00" * 80,                                  # garbage signature
+    ]
+    evil = b"\xff" * 21                                # validly signed junk
+    cases.append(evil + crypto.sign(evil, peer.sk, crypto.DOMAIN_SYNC_REPLY))
+    for i, reply in enumerate(cases, start=1):
+        assert node._decode_signed_blob(reply, peer.pk) is None
+        assert node.bad_replies == i
+
+
+def test_reply_size_caps_on_both_sides():
+    config = SwirldConfig(n_members=2, max_reply_events=5)
+    sim = make_simulation(2, seed=5, config=config)
+    sim.run(40)
+    a, b = sim.nodes
+    # server side: a fresh observer's sync request gets at most 5 events
+    hv = b"".join((0).to_bytes(4, "little") for _ in sim.members)
+    req = hv + crypto.sign(hv, a.sk, crypto.DOMAIN_SYNC_REQ)
+    reply = b.ask_sync(a.pk, req)
+    events = a._decode_signed_blob(reply, b.pk)
+    assert events is not None and len(events) == 5
+    # client side: an over-budget reply is a counted rejection
+    small = SwirldConfig(n_members=2, max_reply_bytes=100)
+    sim2 = make_simulation(2, seed=5, config=small)
+    sim2.run(1)
+    big_reply = b"\x00" * 200
+    assert sim2.nodes[0]._decode_signed_blob(big_reply, sim2.nodes[1].pk) is None
+    assert sim2.nodes[0].bad_replies == 1
+
+
+def test_pull_survives_nonbytes_and_raising_endpoints():
+    """pull() must never raise on peer behavior, even under the default
+    reliable Transport: endpoints that throw arbitrary exceptions or
+    return non-bytes are failed RPCs / counted garbage, not tracebacks."""
+    sim = make_simulation(3, seed=1)
+    sim.run(12)
+    node, evil = sim.nodes[0], sim.nodes[1]
+
+    def boom(from_pk, req):
+        raise TypeError("boom")
+
+    sim.network[evil.pk] = boom
+    assert node.pull(evil.pk) == []        # generic raise -> failed RPC
+    sim.network[evil.pk] = lambda f, r: None
+    before = node.bad_replies
+    assert node.pull(evil.pk) == []        # non-bytes -> counted garbage
+    assert node.bad_replies == before + 1
+
+
+def test_orphan_buffer_byte_budget_eviction():
+    """Plausible-but-unparentable events are parked under a byte budget,
+    not only a count cap — one valid signer cannot balloon memory."""
+    from tpu_swirld.oracle.event import Event
+
+    config = SwirldConfig(n_members=2, max_orphan_bytes=4000)
+    sim = make_simulation(2, seed=3, config=config)
+    a, b = sim.nodes
+    orphans = [
+        Event(
+            d=b"x" * 1000,
+            p=(crypto.hash_bytes(b"gone%d" % i), crypto.hash_bytes(b"g2%d" % i)),
+            t=50 + i, c=b.pk,
+        ).signed(b.sk)
+        for i in range(10)
+    ]
+    a._ingest(orphans, [])
+    assert 0 < a.orphans_parked <= 3          # ~1.2 KB each, 4 KB budget
+    assert a._orphan_bytes <= config.max_orphan_bytes
+    # an event bigger than the whole budget is never parked
+    huge = Event(
+        d=b"y" * 5000,
+        p=(crypto.hash_bytes(b"zz"), crypto.hash_bytes(b"z2")),
+        t=99, c=b.pk,
+    ).signed(b.sk)
+    parked = a.orphans_parked
+    a._ingest([huge], [])
+    assert a.orphans_parked == parked
+
+
+def test_sync_reply_cap_recovers_over_multiple_syncs():
+    """A capped reply is a topo prefix; repeated syncs converge anyway."""
+    config = SwirldConfig(n_members=3, max_reply_events=8)
+    sim = make_simulation(3, seed=13, config=config)
+    sim.run(120)
+    counts = [len(n.hg) for n in sim.nodes]
+    assert min(counts) > 30            # gossip stayed live under the cap
+    orders = [n.consensus for n in sim.nodes]
+    m = min(len(o) for o in orders)
+    assert m > 0 and all(o[:m] == orders[0][:m] for o in orders)

@@ -1,0 +1,374 @@
+"""Chaos harness: end-to-end fault-injection scenarios with verdicts.
+
+:class:`ChaosSimulation` composes every resilience mechanism in one run:
+
+- gossip routed through a :class:`~tpu_swirld.transport.FaultyTransport`
+  (seeded drops / corruption / duplication / reordering / delays,
+  scheduled partitions);
+- node-side retry/backoff, counted rejections, and circuit-breaker
+  quarantine (``config.quarantine_forkers`` is ON: detected equivocators
+  are cut off directly and only reach honest nodes via relays);
+- peer **crashes**: a crashed member loses its in-memory state entirely
+  (its endpoints are torn down), then restarts from its last
+  :mod:`tpu_swirld.checkpoint` file plus its **own-event WAL** — the
+  standard BFT requirement that a signer never lose its own signing
+  history (cf. Tendermint's priv-validator state): without it a restart
+  re-signs at an old sequence number and equivocates against its own
+  lost tip, and every such *amnesia fork* burns one slot of the ``n >
+  3f`` budget.  Restore then replays forward via gossip, with pull-only
+  *recovery sweeps* (orphan/want-list recovery fetches the missing
+  other-parents of WAL events) before the node creates new events;
+- optional byzantine members (:class:`~tpu_swirld.sim.DivergentForker`)
+  riding the same faulty transport, so network and byzantine faults
+  compose.
+
+The run produces a **verdict** dict asserting the two protocol claims:
+
+- *safety*: every honest node's decided consensus order is bit-identical
+  to a prefix of a fault-free **oracle replay** — a fresh observer node
+  that ingests the union of all honest event stores over a reliable
+  transport and recomputes consensus from scratch (consensus is a pure
+  function of the DAG, so this is the ground truth the chaos run must
+  agree with) — and all honest decided prefixes agree pairwise;
+- *liveness*: decided rounds keep advancing after partitions heal and
+  crashed nodes restart.
+
+Scenarios are reproducible from ``(scenario.seed, plan.seed)``:
+``scripts/chaos_run.py`` is the CLI front end and
+``tests/test_chaos.py`` pins the acceptance scenario.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Callable, Dict, List, Optional
+
+from tpu_swirld.checkpoint import load_node, save_node
+from tpu_swirld.config import SwirldConfig
+from tpu_swirld.oracle.graph import toposort
+from tpu_swirld.oracle.node import Node
+from tpu_swirld.sim import DivergentForker, attach_obs, build_population
+from tpu_swirld.transport import FaultPlan, FaultyTransport
+
+
+@dataclasses.dataclass
+class ChaosScenario:
+    """One seeded chaos run: population shape + fault schedule.
+
+    ``plan.crashes`` / ``plan.partitions`` use member indices; crash
+    windows must name honest members (indices >= ``n_forkers``) and close
+    before ``n_turns`` so the liveness claim is testable.
+    """
+
+    n_nodes: int = 5
+    n_turns: int = 300
+    seed: int = 0
+    n_forkers: int = 0
+    fork_every: int = 3
+    plan: FaultPlan = dataclasses.field(default_factory=FaultPlan)
+    checkpoint_every: int = 50
+    recovery_pull_rounds: int = 3   # max pull-only sweeps after a restart
+    tpu_node_index: Optional[int] = None  # honest member on backend="tpu"
+
+    def config(self) -> SwirldConfig:
+        return SwirldConfig(
+            n_members=self.n_nodes, seed=self.seed, quarantine_forkers=True
+        )
+
+
+class ChaosSimulation:
+    """Drive one :class:`ChaosScenario` and produce a verdict."""
+
+    def __init__(
+        self,
+        scenario: ChaosScenario,
+        ckpt_dir: str,
+        metrics=None,
+        tracer=None,
+    ):
+        sc = scenario
+        heal = sc.plan.heal_time()
+        if heal >= sc.n_turns:
+            raise ValueError(
+                f"fault schedule ends at t={heal} but the run is only "
+                f"{sc.n_turns} turns; liveness-after-heal is untestable"
+            )
+        for idx, windows in sc.plan.crashes.items():
+            if idx < sc.n_forkers:
+                raise ValueError("crash windows must name honest members")
+            for down, up in windows:
+                # down >= 1 so the turn-0 checkpoint exists to restore from
+                if not 1 <= down < up:
+                    raise ValueError(f"bad crash window {(down, up)}")
+        self.scenario = sc
+        self.ckpt_dir = ckpt_dir
+        os.makedirs(ckpt_dir, exist_ok=True)
+        self.metrics = metrics
+        self.tracer = tracer
+        self.config = sc.config()
+        pop = build_population(
+            sc.n_nodes, sc.seed,
+            transport_factory=lambda network, want, members, clock:
+                FaultyTransport(network, want, sc.plan, members, clock),
+        )
+        self.rng = pop.rng
+        self.keys = pop.keys
+        self.members = pop.members
+        self.network: Dict[bytes, Callable] = pop.network
+        self.network_want: Dict[bytes, Callable] = pop.network_want
+        self.clock = pop.clock
+        self.transport: FaultyTransport = pop.transport
+        self.forkers: List[DivergentForker] = []
+        # honest nodes indexed by MEMBER index (None while crashed)
+        self.nodes: Dict[int, Optional[Node]] = {}
+        for i, (pk, sk) in enumerate(self.keys):
+            if i < sc.n_forkers:
+                f = DivergentForker(
+                    sk, pk, self.members, self.network, self.network_want,
+                    self.config, lambda: self.clock[0], self.rng,
+                    transport=self.transport,
+                )
+                self.network[pk] = f.ask_sync
+                self.network_want[pk] = f.ask_events
+                self.forkers.append(f)
+            else:
+                self.nodes[i] = self._make_node(i)
+        self.crashes = 0
+        self.restarts = 0
+        # own-event WAL: the durable log of each member's self-signed
+        # events since its last checkpoint (see the module docstring)
+        self._wal: Dict[int, List] = {i: [] for i in self.nodes}
+        self._decided_at_heal: Optional[int] = None
+        self._heal_t = heal
+
+    # ----------------------------------------------------------- plumbing
+
+    def _node_config(self, i: int) -> SwirldConfig:
+        if self.scenario.tpu_node_index == i:
+            return dataclasses.replace(
+                self.config, backend="tpu", block_size=128
+            )
+        return self.config
+
+    def _make_node(self, i: int) -> Node:
+        pk, sk = self.keys[i]
+        node = Node(
+            sk=sk, pk=pk, network=self.network, members=self.members,
+            config=self._node_config(i), clock=lambda: self.clock[0],
+            network_want=self.network_want, transport=self.transport,
+        )
+        attach_obs(node, self.metrics, self.tracer)
+        self.network[pk] = node.ask_sync
+        self.network_want[pk] = node.ask_events
+        return node
+
+    def _ckpt_path(self, i: int) -> str:
+        return os.path.join(self.ckpt_dir, f"node-{i}.swck")
+
+    def _crash(self, i: int) -> None:
+        """Kill member i: all in-memory state is lost, endpoints torn
+        down, and the transport refuses routes until restart."""
+        pk = self.members[i]
+        self.nodes[i] = None
+        self.network.pop(pk, None)
+        self.network_want.pop(pk, None)
+        self.transport.set_down(pk)
+        self.crashes += 1
+
+    def _restore(self, i: int) -> None:
+        """Restart member i from its last checkpoint + own-event WAL and
+        replay forward: WAL events whose other-parents are not in the
+        checkpoint park as orphans; the recovery sweeps' want-list
+        round-trips fetch those parents, draining the orphans and moving
+        the node's head back to its true pre-crash tip — so new events
+        extend the chain instead of equivocating against it."""
+        pk, sk = self.keys[i]
+        node = load_node(
+            self._ckpt_path(i), sk=sk, pk=pk, network=self.network,
+            network_want=self.network_want, clock=lambda: self.clock[0],
+            transport=self.transport,
+        )
+        attach_obs(node, self.metrics, self.tracer)
+        self.transport.set_up(pk)
+        self.network[pk] = node.ask_sync
+        self.network_want[pk] = node.ask_events
+        self.nodes[i] = node
+        self.restarts += 1
+        wal_ids: List[bytes] = []
+        node._ingest(self._wal[i], wal_ids)
+        if wal_ids:
+            node.consensus_pass(wal_ids)
+        for _ in range(max(0, self.scenario.recovery_pull_rounds)):
+            progress = False
+            for peer in self.members:
+                if peer == pk or peer in self.transport.down:
+                    continue
+                got = node.pull(peer)
+                if got:
+                    node.consensus_pass(got)
+                    progress = True
+            if not progress and not node._orphans:
+                break
+
+    def _checkpoint_all(self) -> None:
+        for i, node in self.nodes.items():
+            if node is not None:
+                save_node(self._ckpt_path(i), node)
+                # the checkpoint covers everything it ingested; entries a
+                # restored node has not re-learned yet stay durable
+                self._wal[i] = [
+                    ev for ev in self._wal[i] if ev.id not in node.hg
+                ]
+
+    def _live_honest(self) -> List[Node]:
+        return [n for n in self.nodes.values() if n is not None]
+
+    def _min_decided(self) -> int:
+        live = self._live_honest()
+        return min(len(n.consensus) for n in live) if live else 0
+
+    # ---------------------------------------------------------------- run
+
+    def run(self) -> Dict:
+        sc = self.scenario
+        honest_pks = [self.members[i] for i in self.nodes]
+        for turn in range(sc.n_turns):
+            self.clock[0] = turn
+            for idx, windows in sc.plan.crashes.items():
+                for down, up in windows:
+                    if turn == down and self.nodes.get(idx) is not None:
+                        self._crash(idx)
+                    elif turn == up and self.nodes.get(idx) is None:
+                        self._restore(idx)
+            if turn % max(1, sc.checkpoint_every) == 0:
+                self._checkpoint_all()
+            live = [i for i, n in self.nodes.items() if n is not None]
+            if not live:
+                continue   # whole-cluster outage window: dead air
+            ni = live[self.rng.randrange(len(live))]
+            node = self.nodes[ni]
+            peers = [pk for pk in self.members if pk != node.pk]
+            peer = peers[self.rng.randrange(len(peers))]
+            wal = self._wal[ni]
+            if wal and node.head != wal[-1].id:
+                # restored but its own signing tail is still orphaned
+                # (e.g. restarted inside a partition): pull-only turns —
+                # signing now would equivocate against the lost tip
+                got = node.pull(peer)
+                if got:
+                    node.consensus_pass(got)
+            else:
+                prev_head = node.head
+                new_ids = node.sync(peer, b"tx:%d:%d" % (ni, turn))
+                node.consensus_pass(new_ids)
+                if node.head != prev_head:
+                    wal.append(node.hg[node.head])
+            if sc.n_forkers and turn % max(1, sc.fork_every) == 0:
+                for f in self.forkers:
+                    f.step(honest_pks)
+            if turn == self._heal_t:
+                self._decided_at_heal = self._min_decided()
+        # any member still down at the end comes back for the verdict
+        for idx, node in list(self.nodes.items()):
+            if node is None:
+                self._restore(idx)
+        return self.verdict()
+
+    # ------------------------------------------------------------ verdict
+
+    def oracle_order(self) -> List[bytes]:
+        """Fault-free ground truth: a fresh observer replays the union of
+        every honest store over a reliable path and recomputes consensus
+        from scratch.  By purity of the consensus functions this is the
+        order every honest node must have decided a prefix of."""
+        union = {}
+        for n in self._live_honest():
+            union.update(n.hg)
+        ordered = toposort(
+            sorted(union, key=lambda e: (union[e].t, e)),
+            lambda e: [p for p in union[e].p],
+        )
+        pk, sk = self.keys[-1]
+        observer = Node(
+            sk=sk, pk=pk, network={}, members=self.members,
+            config=self.config, create_genesis=False,
+        )
+        new_ids = []
+        for eid in ordered:
+            if observer.add_event(union[eid]):
+                new_ids.append(eid)
+        observer.consensus_pass(new_ids)
+        return observer.consensus
+
+    def verdict(self) -> Dict:
+        nodes = self._live_honest()
+        orders = [n.consensus for n in nodes]
+        m = min(len(o) for o in orders) if orders else 0
+        prefix_agree = all(o[:m] == orders[0][:m] for o in orders)
+        oracle = self.oracle_order()
+        oracle_agree = all(
+            o == oracle[: len(o)] for o in orders
+        )
+        decided_final = self._min_decided()
+        heal_base = (
+            self._decided_at_heal if self._decided_at_heal is not None else 0
+        )
+        live_after_heal = decided_final > heal_base or self._heal_t == 0
+        quarantined = sorted(
+            {
+                self.transport.member_index.get(p, -1)
+                for n in nodes
+                for p in n.breaker.quarantined()
+            }
+        )
+        ok = bool(
+            prefix_agree and oracle_agree and decided_final > 0
+            and live_after_heal
+        )
+        return {
+            "ok": ok,
+            "safety": {
+                "prefix_agree": prefix_agree,
+                "oracle_agree": oracle_agree,
+                "common_prefix_len": m,
+                "oracle_len": len(oracle),
+            },
+            "liveness": {
+                "decided_at_heal": heal_base,
+                "decided_final": decided_final,
+                "advanced_after_heal": live_after_heal,
+                "heal_turn": self._heal_t,
+            },
+            "faults": dict(self.transport.stats),
+            "resilience": {
+                "crashes": self.crashes,
+                "restarts": self.restarts,
+                "retries": sum(n.retries for n in nodes),
+                "backoff_total": round(
+                    sum(n.backoff_total for n in nodes), 3
+                ),
+                "bad_replies": sum(n.bad_replies for n in nodes),
+                "bad_requests": sum(n.bad_requests for n in nodes),
+                "circuit_opens": sum(n.circuit_opens for n in nodes),
+                "quarantined_member_indices": quarantined,
+                "forks_detected": max(n.forks_detected for n in nodes),
+                "orphans_parked": sum(n.orphans_parked for n in nodes),
+            },
+            "scenario": {
+                "seed": self.scenario.seed,
+                "plan_seed": self.scenario.plan.seed,
+                "n_nodes": self.scenario.n_nodes,
+                "n_turns": self.scenario.n_turns,
+                "n_forkers": self.scenario.n_forkers,
+            },
+        }
+
+
+def run_chaos(
+    scenario: ChaosScenario, ckpt_dir: str, metrics=None, tracer=None
+) -> Dict:
+    """Build + run one scenario; returns the verdict dict."""
+    return ChaosSimulation(
+        scenario, ckpt_dir, metrics=metrics, tracer=tracer
+    ).run()

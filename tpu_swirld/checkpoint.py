@@ -114,9 +114,16 @@ def load_node(
     network: Dict[bytes, Callable],
     network_want: Optional[Dict[bytes, Callable]] = None,
     clock: Optional[Callable[[], int]] = None,
+    transport=None,
 ) -> Node:
     """Rebuild a node from a checkpoint: replay the validated event log and
-    run one batch consensus pass (bit-identical by purity)."""
+    run one batch consensus pass (bit-identical by purity).
+
+    ``transport`` re-attaches the restored node to a shared delivery layer
+    (the crash-recovery path: a restarted node rejoins the same
+    — possibly faulty — network it crashed out of and replays forward via
+    gossip).
+    """
     with open(path, "rb") as f:
         data = f.read()
     if data[:4] != b"SWCK":
@@ -132,6 +139,7 @@ def load_node(
     node = Node(
         sk=sk, pk=pk, network=network, members=members, config=cfg,
         clock=clock, create_genesis=False, network_want=network_want,
+        transport=transport,
     )
     off = 8 + hlen
     new_ids = []

@@ -43,10 +43,29 @@ class SwirldConfig:
     block_size: int = 256
     max_rounds: int = 256
     max_orphans: int = 4096      # unknown-parent events parked per node
+    max_orphan_bytes: int = 8 << 20  # byte budget for the orphan buffer
+                                 # (count cap alone admits ~4 GiB of
+                                 # max-payload events from one signer)
     max_want_rounds: int = 32    # want-list round-trips per sync
     tpu_min_batch: int = 1       # backend='tpu': min new events per device
                                  # pass (higher amortizes the batch replay;
                                  # consensus output is identical, delayed)
+
+    # --- gossip resilience (transport retry / reply caps / quarantine) ---
+    # Retry/backoff units are logical clock ticks (see transport.RetryPolicy);
+    # nothing sleeps — the sim records delays, real deployments may sleep.
+    retry_attempts: int = 3      # total transport attempts per call
+    retry_backoff: float = 1.0   # first-retry backoff (doubles per retry)
+    retry_backoff_cap: float = 8.0
+    retry_jitter: float = 0.5    # extra uniform [0, jitter*delay] per retry
+    retry_deadline: float = 16.0  # per-peer total backoff budget per pull
+    breaker_failures: int = 4    # consecutive transport failures to open
+    breaker_misbehavior: int = 12  # attributable-garbage strikes to open
+    breaker_cooldown: float = 24.0  # ticks before a half-open probe
+    max_reply_bytes: int = 1 << 24  # reject larger sync/want replies
+    max_reply_events: int = 65536   # server-side cap on events per reply
+    quarantine_forkers: bool = False  # detected equivocators trip the
+                                      # circuit breaker immediately
 
     def stakes(self) -> Tuple[int, ...]:
         if self.stake is not None:

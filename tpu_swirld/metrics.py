@@ -118,6 +118,11 @@ def node_gauges(
         "ancient_quarantined": len(getattr(node, "ancient", ())),
         "forks_detected": getattr(node, "forks_detected", 0),
         "bad_replies": getattr(node, "bad_replies", 0),
+        "bad_requests": getattr(node, "bad_requests", 0),
+        "retries": getattr(node, "retries", 0),
+        "backoff_total": getattr(node, "backoff_total", 0.0),
+        "quarantined_peers": getattr(node, "quarantined_peers", 0),
+        "circuit_opens": getattr(node, "circuit_opens", 0),
     }
     if registry is not None:
         if node_label is None:

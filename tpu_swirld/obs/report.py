@@ -64,6 +64,32 @@ def gauge_rows(events: List[Dict]) -> List[Dict]:
     return rows
 
 
+# The chaos/resilience failure surface gets its own report section so a
+# fault-injected run's health reads at a glance: transport fault counters
+# (FaultyTransport), retry/backoff/circuit-breaker counters (Node), and
+# the incremental driver's storm-guard decision gauges.
+_RESILIENCE_PREFIXES = (
+    "transport_",
+    "gossip_transport_errors",
+    "gossip_retries",
+    "gossip_backoff",
+    "gossip_deadline",
+    "gossip_circuit",
+    "gossip_bad_",
+    "incremental_storm",
+    "incremental_consecutive_rebases",
+    "node_bad_",
+    "node_retries",
+    "node_backoff",
+    "node_quarantined",
+    "node_circuit",
+)
+
+
+def is_resilience_row(g: Dict) -> bool:
+    return any(g["name"].startswith(p) for p in _RESILIENCE_PREFIXES)
+
+
 def render_report(events: List[Dict]) -> str:
     spans = aggregate_spans(events)
     gauges = gauge_rows(events)
@@ -88,14 +114,22 @@ def render_report(events: List[Dict]) -> str:
             )
     else:
         lines.append("(no spans in trace)")
+    resilience = [g for g in gauges if is_resilience_row(g)]
+    protocol = [g for g in gauges if not is_resilience_row(g)]
     lines.append("")
     lines.append("== protocol gauges ==")
-    if gauges:
-        width = max(len(_gauge_name(g)) for g in gauges)
-        for g in gauges:
+    if protocol:
+        width = max(len(_gauge_name(g)) for g in protocol)
+        for g in protocol:
             lines.append(f"{_gauge_name(g):<{width}}  {g['value']}")
     else:
         lines.append("(no counter samples in trace)")
+    if resilience:
+        lines.append("")
+        lines.append("== resilience (faults / retries / quarantine) ==")
+        width = max(len(_gauge_name(g)) for g in resilience)
+        for g in resilience:
+            lines.append(f"{_gauge_name(g):<{width}}  {g['value']}")
     return "\n".join(lines)
 
 

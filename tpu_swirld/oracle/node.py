@@ -55,6 +55,7 @@ here so both backends agree):
 
 from __future__ import annotations
 
+import random
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 from tpu_swirld import crypto
@@ -62,6 +63,14 @@ from tpu_swirld.config import SwirldConfig
 from tpu_swirld.obs import phase_scope
 from tpu_swirld.oracle.event import Event, decode_event, encode_event
 from tpu_swirld.oracle.graph import toposort
+from tpu_swirld.transport import (
+    CHANNEL_SYNC,
+    CHANNEL_WANT,
+    CircuitBreaker,
+    RetryPolicy,
+    Transport,
+    TransportError,
+)
 
 
 def _bit_count(x: int) -> int:
@@ -85,6 +94,7 @@ class Node:
         clock: Optional[Callable[[], int]] = None,
         create_genesis: bool = True,
         network_want: Optional[Dict[bytes, Callable]] = None,
+        transport: Optional[Transport] = None,
     ):
         self.config = config or SwirldConfig(n_members=len(members))
         if len(members) != self.config.n_members:
@@ -94,7 +104,11 @@ class Node:
         self.network = network
         self.network_want = network_want if network_want is not None else {}
         self._orphans: Dict[bytes, Event] = {}
-        self.bad_replies = 0  # malformed/mis-signed replies tolerated so far
+        self._orphan_bytes = 0   # tracked against config.max_orphan_bytes
+        self.bad_replies = 0   # malformed/mis-signed replies tolerated so far
+        self.bad_requests = 0  # malformed requests served an empty reply
+        self.retries = 0       # transport retry attempts issued
+        self.backoff_total = 0.0  # cumulative backoff (logical ticks)
         self.metrics = None   # set to metrics.Metrics() to enable counters
         self.tracer = None    # set to obs.Tracer() to record phase spans
         self._tpu_engine = None   # lazily built when config.backend == "tpu"
@@ -104,6 +118,37 @@ class Node:
         self.stake: Dict[bytes, int] = {m: stakes[i] for i, m in enumerate(members)}
         self.tot_stake = sum(stakes)
         self._clock = clock or self._lamport_clock
+
+        # --- gossip resilience: transport seam, retry policy, breaker ---
+        # The default Transport routes over the same network dicts as the
+        # pre-transport code (reliable, in-process); pass a FaultyTransport
+        # to exercise the failure surface.
+        self.transport = (
+            transport
+            if transport is not None
+            else Transport(self.network, self.network_want)
+        )
+        cfg = self.config
+        self.retry_policy = RetryPolicy(
+            attempts=cfg.retry_attempts,
+            backoff_base=cfg.retry_backoff,
+            backoff_cap=cfg.retry_backoff_cap,
+            jitter=cfg.retry_jitter,
+            deadline=cfg.retry_deadline,
+        )
+        self.breaker = CircuitBreaker(
+            clock=self._clock,
+            failure_threshold=cfg.breaker_failures,
+            misbehavior_threshold=cfg.breaker_misbehavior,
+            cooldown=cfg.breaker_cooldown,
+        )
+        # deterministic per-node jitter stream (reproducible chaos runs)
+        self._retry_rng = random.Random(
+            int.from_bytes(crypto.hash_bytes(b"retry" + pk)[:8], "little")
+            ^ cfg.seed
+        )
+        self._sleep: Optional[Callable[[float], None]] = None  # real
+        # deployments may install time.sleep; sims keep time logical
 
         # --- event store / DAG ---
         self.hg: Dict[bytes, Event] = {}          # id -> Event
@@ -163,6 +208,16 @@ class Node:
     def forks_detected(self) -> int:
         """Members this node has seen fork (public gauge surface)."""
         return sum(1 for v in self.has_fork.values() if v)
+
+    @property
+    def quarantined_peers(self) -> int:
+        """Peers with an open circuit breaker (public gauge surface)."""
+        return len(self.breaker.quarantined()) if self.breaker else 0
+
+    @property
+    def circuit_opens(self) -> int:
+        """Lifetime circuit-breaker open transitions (gauge surface)."""
+        return self.breaker.opens if self.breaker else 0
 
     def _now(self) -> int:
         t = int(self._clock())
@@ -241,6 +296,17 @@ class Node:
             self.has_fork[c] = True
             if self.metrics is not None:
                 self.metrics.count("gossip_fork_pairs_detected")
+            if (
+                self.config.quarantine_forkers
+                and self.breaker is not None
+                and c != self.pk
+            ):
+                # fork detection feeds the breaker: a proven equivocator
+                # is quarantined outright (its events still arrive via
+                # honest relays; we just stop gossiping with it directly)
+                self.breaker.record_misbehavior(
+                    c, weight=self.breaker.misbehavior_threshold
+                )
         if not self.has_fork[c]:
             self.member_chain[c].append(eid)   # index == seq while honest
         if c == self.pk:
@@ -360,11 +426,19 @@ class Node:
         """
         if from_pk not in self.member_index:
             raise ValueError("unknown sync peer")
+        if (
+            len(signed_heights) < crypto.SIG_BYTES
+            or len(signed_heights) > self.config.max_reply_bytes
+        ):
+            self.bad_requests += 1
+            raise ValueError("truncated or oversized sync request")
         payload = signed_heights[: -crypto.SIG_BYTES]
         sig = signed_heights[-crypto.SIG_BYTES:]
         if not crypto.verify(payload, sig, from_pk, crypto.DOMAIN_SYNC_REQ):
+            self.bad_requests += 1
             raise ValueError("bad sync-request signature")
         if len(payload) != 4 * len(self.members):
+            self.bad_requests += 1
             raise ValueError("malformed sync-request height vector")
         heights: Dict[bytes, int] = {}
         off = 0
@@ -384,41 +458,101 @@ class Node:
             sorted(ids, key=lambda e: self.idx[e]),
             lambda e: [p for p in self.hg[e].p],
         )
-        blob = b"".join(encode_event(self.hg[e]) for e in ordered)
+        # reply-size caps, by count AND bytes: a topo *prefix* stays valid
+        # to ingest, and the asker recovers the remainder through later
+        # syncs / want-lists.  The byte cap must mirror the asker's
+        # _decode_signed_blob budget — an over-budget reply would read as
+        # misbehavior there, livelocking two honest peers forever.
+        cap = self.config.max_reply_events
+        if len(ordered) > cap:
+            ordered = ordered[:cap]
+        budget = self.config.max_reply_bytes - crypto.SIG_BYTES
+        parts: List[bytes] = []
+        size = 0
+        for e in ordered:
+            enc = encode_event(self.hg[e])
+            if size + len(enc) > budget:
+                break
+            parts.append(enc)
+            size += len(enc)
+        blob = b"".join(parts)
         return blob + crypto.sign(blob, self.sk, crypto.DOMAIN_SYNC_REPLY)
 
     def ask_events(self, from_pk: bytes, signed_want: bytes) -> bytes:
         """Serve a want-list: the asker requests specific event ids (orphan
         parents it is missing); reply with those we have, topo-sorted and
-        signed.  Unknown ids are silently skipped."""
+        signed.  Unknown ids are silently skipped.
+
+        Truncated / garbage / oversized requests (an attacker, or a lossy
+        transport mangling bytes in flight) are answered with a signed
+        EMPTY reply and counted in ``bad_requests`` — a byzantine asker
+        must not be able to crash the serving side.
+        """
         if from_pk not in self.member_index:
             raise ValueError("unknown sync peer")
+        if (
+            len(signed_want) < crypto.SIG_BYTES
+            or len(signed_want) > self.config.max_reply_bytes
+        ):
+            return self._reject_request()
         payload = signed_want[: -crypto.SIG_BYTES]
         sig = signed_want[-crypto.SIG_BYTES:]
         if not crypto.verify(payload, sig, from_pk, crypto.DOMAIN_WANT):
-            raise ValueError("bad want-list signature")
+            return self._reject_request()
         if len(payload) % crypto.HASH_BYTES:
-            raise ValueError("malformed want-list")
+            return self._reject_request()
         want = [
             payload[i : i + crypto.HASH_BYTES]
             for i in range(0, len(payload), crypto.HASH_BYTES)
         ]
+        del want[self.config.max_reply_events:]   # cap the work we do
         have = [h for h in want if h in self.hg]
         return self._sign_event_blob(have)
 
-    def _decode_signed_blob(self, reply: bytes, peer_pk: bytes) -> List[Event]:
-        if len(reply) < crypto.SIG_BYTES:
-            raise ValueError("short sync reply")
+    def _reject_request(self) -> bytes:
+        """Counted rejection of a malformed inbound request: a signed
+        empty reply (decodes cleanly on an honest asker's side)."""
+        self.bad_requests += 1
+        if self.metrics is not None:
+            self.metrics.count("gossip_bad_requests")
+        return self._sign_event_blob([])
+
+    def _decode_signed_blob(
+        self, reply: bytes, peer_pk: bytes
+    ) -> Optional[List[Event]]:
+        """Decode a signed event blob; ``None`` on any malformation.
+
+        Truncated, garbage, mis-signed, or oversized replies degrade to a
+        *counted rejection* (``bad_replies`` + a misbehavior strike on the
+        peer's circuit breaker) — never an uncaught exception.  The size
+        cap bounds decode work before the signature is even checked.
+        """
+        if (
+            len(reply) < crypto.SIG_BYTES
+            or len(reply) > self.config.max_reply_bytes
+        ):
+            return self._reject_reply(peer_pk)
         blob = reply[: -crypto.SIG_BYTES]
         sig = reply[-crypto.SIG_BYTES:]
         if not crypto.verify(blob, sig, peer_pk, crypto.DOMAIN_SYNC_REPLY):
-            raise ValueError("bad sync-reply signature")
+            return self._reject_reply(peer_pk)
         events: List[Event] = []
         off = 0
-        while off < len(blob):
-            ev, off = decode_event(blob, off)   # raises MalformedEvent
-            events.append(ev)
+        try:
+            while off < len(blob):
+                ev, off = decode_event(blob, off)   # raises MalformedEvent
+                events.append(ev)
+        except ValueError:
+            return self._reject_reply(peer_pk)
         return events
+
+    def _reject_reply(self, peer_pk: bytes) -> None:
+        self.bad_replies += 1
+        if self.metrics is not None:
+            self.metrics.count("gossip_bad_replies")
+        if self.breaker is not None:
+            self.breaker.record_misbehavior(peer_pk)
+        return None
 
     def _ingest(self, events: Iterable[Event], new_ids: List[bytes]) -> None:
         """Insert events whose parents are known; park the rest as orphans,
@@ -431,15 +565,25 @@ class Node:
                 # park only events that are at least self-consistent (known
                 # creator, size caps, valid signature, parent arity) — junk
                 # must not be able to occupy the buffer; and evict FIFO when
-                # full so poisoning can't permanently disable recovery
+                # over the count OR byte budget so poisoning can neither
+                # disable recovery nor balloon memory (one valid signer
+                # could otherwise park max_orphans * MAX_PAYLOAD bytes)
                 if (
-                    self.config.max_orphans > 0
+                    eid not in self._orphans   # re-sent: already parked
+                    and self.config.max_orphans > 0
                     and len(ev.p) == 2
                     and self._plausible(ev)
                 ):
-                    if len(self._orphans) >= self.config.max_orphans:
-                        self._orphans.pop(next(iter(self._orphans)))
-                    self._orphans[eid] = ev
+                    cost = self._orphan_cost(ev)
+                    if cost <= self.config.max_orphan_bytes:
+                        while self._orphans and (
+                            len(self._orphans) >= self.config.max_orphans
+                            or self._orphan_bytes + cost
+                            > self.config.max_orphan_bytes
+                        ):
+                            self._evict_orphan(next(iter(self._orphans)))
+                        self._orphans[eid] = ev
+                        self._orphan_bytes += cost
                 continue
             try:
                 if self.add_event(ev):
@@ -452,13 +596,22 @@ class Node:
             progress = False
             for eid, ev in list(self._orphans.items()):
                 if not ev.p or all(p in self.hg for p in ev.p):
-                    del self._orphans[eid]
+                    self._evict_orphan(eid)
                     try:
                         if self.add_event(ev):
                             new_ids.append(eid)
                             progress = True
                     except ValueError:
                         pass   # invalid orphan: drop it
+
+    @staticmethod
+    def _orphan_cost(ev: Event) -> int:
+        """Approximate resident bytes of a parked event (wire size)."""
+        return len(ev.d) + len(ev.c) + len(ev.s) + 2 * crypto.HASH_BYTES + 24
+
+    def _evict_orphan(self, eid: bytes) -> None:
+        ev = self._orphans.pop(eid)
+        self._orphan_bytes -= self._orphan_cost(ev)
 
     def _plausible(self, ev: Event) -> bool:
         """Parent-independent validity: creator, size caps, signature."""
@@ -481,54 +634,125 @@ class Node:
             }
         )
 
+    def _transport_call(
+        self, peer_pk: bytes, channel: str, payload: bytes
+    ) -> Optional[bytes]:
+        """One logical request over the transport with bounded retry.
+
+        Transport failures (drops, partitions, timeouts, crashed peers)
+        are retried up to ``retry_policy.attempts`` times with exponential
+        backoff + per-node deterministic jitter, stopping early when the
+        per-peer deadline is exhausted or the circuit breaker opens.
+        Backoff is *logical*: delays are recorded (``backoff_total``,
+        ``gossip_backoff_time``) and handed to ``self._sleep`` if one is
+        installed — simulations never block on wall-clock sleeps.
+
+        Returns the raw reply, or ``None`` when the call ultimately
+        failed (always counted, never raised).
+        """
+        met = self.metrics
+        pol = self.retry_policy
+        br = self.breaker
+        attempts = max(1, pol.attempts)
+        spent = 0.0
+        result: Optional[bytes] = None
+        for attempt in range(attempts):
+            try:
+                result = self.transport.call(
+                    self.pk, peer_pk, channel, payload
+                )
+                if not isinstance(result, (bytes, bytearray)):
+                    # a non-bytes reply is peer garbage, not a reply
+                    raise ValueError("non-bytes reply")
+                break
+            except TransportError:
+                if met is not None:
+                    met.count("gossip_transport_errors")
+                if br is not None:
+                    before = br.opens
+                    br.record_failure(peer_pk)
+                    if br.opens > before:
+                        if met is not None:
+                            met.count("gossip_circuit_opens")
+                        break   # breaker just opened: stop hammering
+                if attempt + 1 >= attempts:
+                    break
+                delay = pol.backoff(attempt, self._retry_rng)
+                if spent + delay > pol.deadline:
+                    if met is not None:
+                        met.count("gossip_deadline_exceeded")
+                    break
+                spent += delay
+                self.retries += 1
+                if met is not None:
+                    met.count("gossip_retries")
+                    met.count("gossip_backoff_time", delay)
+                if self._sleep is not None:
+                    self._sleep(delay)
+            except ValueError:
+                # legacy direct-dict path: the peer rejected our request —
+                # attributable misbehavior (or our bug), not retryable
+                self.bad_replies += 1
+                if met is not None:
+                    met.count("gossip_bad_replies")
+                if br is not None:
+                    br.record_misbehavior(peer_pk)
+                self.backoff_total += spent
+                return None
+        self.backoff_total += spent
+        return result
+
     def pull(self, peer_pk: bytes) -> List[bytes]:
         """Receive the peer's delta (no own-event creation).
 
-        Events with unknown parents never crash the node: they are parked
-        in an orphan buffer and their missing ancestors are requested from
-        the same peer by hash (want-list), iterating to closure.  Anything
-        the peer cannot supply stays parked for later syncs.
+        Resilient by construction: transport failures retry with backoff
+        (:meth:`_transport_call`), malformed replies degrade to counted
+        rejections, unknown-parent events park in the orphan buffer with
+        want-list recovery, and peers that keep failing or misbehaving are
+        quarantined by the circuit breaker (calls fail fast until a
+        cooldown elapses).  ``pull`` never raises on peer behavior.
         """
+        new_ids: List[bytes] = []
+        met = self.metrics
+        br = self.breaker
+        if br is not None and not br.allow(peer_pk):
+            if met is not None:
+                met.count("gossip_circuit_fastfail")
+            return new_ids
         hv = b"".join(
             len(self.member_events[m]).to_bytes(4, "little") for m in self.members
         )
         req = hv + crypto.sign(hv, self.sk, crypto.DOMAIN_SYNC_REQ)
-        new_ids: List[bytes] = []
-        met = self.metrics
         if met is not None:
             met.count("gossip_syncs")
             met.count("gossip_bytes_out", len(req))
-        try:
-            reply = self.network[peer_pk](self.pk, req)
-            events = self._decode_signed_blob(reply, peer_pk)
-        except ValueError:
-            # bad signature or malformed blob: a byzantine peer must not be
-            # able to kill our gossip loop — treat as a failed gossip round
-            self.bad_replies += 1
-            if met is not None:
-                met.count("gossip_bad_replies")
+        reply = self._transport_call(peer_pk, CHANNEL_SYNC, req)
+        if reply is None:
             return new_ids
+        events = self._decode_signed_blob(reply, peer_pk)
+        if events is None:
+            return new_ids
+        if br is not None:
+            br.record_success(peer_pk)
         if met is not None:
             met.count("gossip_bytes_in", len(reply))
         self._ingest(events, new_ids)
         # want-list recovery: bounded by DAG depth, capped defensively
-        ask = self.network_want.get(peer_pk)
+        has_want = self.transport.endpoint(peer_pk, CHANNEL_WANT) is not None
         for _ in range(self.config.max_want_rounds):
             want = self._missing_parents()
-            if not want or ask is None:
+            if not want or not has_want:
                 break
             wv = b"".join(want)
             wreq = wv + crypto.sign(wv, self.sk, crypto.DOMAIN_WANT)
             if met is not None:
                 met.count("gossip_want_roundtrips")
                 met.count("gossip_bytes_out", len(wreq))
-            try:
-                wreply = ask(self.pk, wreq)
-                got = self._decode_signed_blob(wreply, peer_pk)
-            except ValueError:
-                self.bad_replies += 1
-                if met is not None:
-                    met.count("gossip_bad_replies")
+            wreply = self._transport_call(peer_pk, CHANNEL_WANT, wreq)
+            if wreply is None:
+                break
+            got = self._decode_signed_blob(wreply, peer_pk)
+            if got is None:
                 break
             if met is not None:
                 met.count("gossip_bytes_in", len(wreply))

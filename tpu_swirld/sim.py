@@ -11,13 +11,14 @@ from __future__ import annotations
 
 import dataclasses
 import random
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from tpu_swirld import crypto
 from tpu_swirld.config import SwirldConfig
 from tpu_swirld.metrics import Metrics
 from tpu_swirld.oracle.event import Event
 from tpu_swirld.oracle.node import Node
+from tpu_swirld.transport import Transport
 
 
 def attach_obs(node: Node, metrics=None, tracer=None) -> None:
@@ -37,6 +38,53 @@ def attach_obs(node: Node, metrics=None, tracer=None) -> None:
 
 
 @dataclasses.dataclass
+class Population:
+    """Shared bootstrap of a gossip population: deterministic member
+    keys, the endpoint dicts, the logical clock, the transport, and the
+    seeded RNG.  This is the ONE place key derivation lives —
+    :func:`make_simulation`, :func:`run_with_divergent_forkers`, and the
+    chaos harness all build on it, so checkpoints and oracle replays
+    always agree on member identities for a given seed."""
+
+    keys: List[Tuple[bytes, bytes]]
+    members: List[bytes]
+    network: Dict[bytes, Callable]
+    network_want: Dict[bytes, Callable]
+    clock: List[int]
+    transport: Transport
+    rng: random.Random
+
+
+def build_population(
+    n_nodes: int,
+    seed: int = 0,
+    transport_factory: Optional[Callable] = None,
+) -> Population:
+    """Derive keys and wire the (initially empty) gossip network.
+
+    ``transport_factory(network, network_want, members, clock)`` builds
+    the delivery layer; default is the reliable in-process
+    :class:`~tpu_swirld.transport.Transport`.
+    """
+    rng = random.Random(seed)
+    keys = [crypto.keypair(b"member-%d-%d" % (seed, i)) for i in range(n_nodes)]
+    members = [pk for pk, _ in keys]
+    network: Dict[bytes, Callable] = {}
+    network_want: Dict[bytes, Callable] = {}
+    clock = [0]
+    if transport_factory is not None:
+        transport = transport_factory(
+            network, network_want, members, lambda: clock[0]
+        )
+    else:
+        transport = Transport(network, network_want)
+    return Population(
+        keys=keys, members=members, network=network,
+        network_want=network_want, clock=clock, transport=transport, rng=rng,
+    )
+
+
+@dataclasses.dataclass
 class Simulation:
     """A population of in-process nodes plus the shared gossip 'network'."""
 
@@ -45,6 +93,7 @@ class Simulation:
     network: Dict[bytes, Callable]
     rng: random.Random
     clock: List[int]
+    transport: Optional[Transport] = None
 
     @property
     def members(self) -> List[bytes]:
@@ -87,6 +136,7 @@ def make_simulation(
     config: Optional[SwirldConfig] = None,
     metrics=None,
     tracer=None,
+    transport_factory: Optional[Callable] = None,
 ) -> Simulation:
     """Build keypairs, the shared network dict, and N nodes (the reference's
     ``test(n_nodes, n_turns)`` setup).
@@ -95,32 +145,38 @@ def make_simulation(
     and phase spans into every node at construction time — no post-hoc
     patching.  Pass one shared ``Metrics`` to aggregate the population's
     gossip traffic into a single registry.
+
+    ``transport_factory(network, network_want, members, clock)`` builds the
+    shared delivery layer (default: the reliable in-process
+    :class:`~tpu_swirld.transport.Transport`); pass a
+    :class:`~tpu_swirld.transport.FaultyTransport` builder to inject
+    network faults into an otherwise-ordinary simulation.
     """
     config = config or SwirldConfig(n_members=n_nodes, seed=seed)
     if config.n_members != n_nodes:
         raise ValueError("config.n_members != n_nodes")
-    rng = random.Random(seed)
-    keys = [crypto.keypair(b"member-%d-%d" % (seed, i)) for i in range(n_nodes)]
-    members = [pk for pk, _ in keys]
-    network: Dict[bytes, Callable] = {}
-    network_want: Dict[bytes, Callable] = {}
-    clock = [0]
+    pop = build_population(n_nodes, seed, transport_factory)
+    clock = pop.clock
     nodes: List[Node] = []
-    for pk, sk in keys:
+    for pk, sk in pop.keys:
         node = Node(
             sk=sk,
             pk=pk,
-            network=network,
-            members=members,
+            network=pop.network,
+            members=pop.members,
             config=config,
             clock=lambda: clock[0],
-            network_want=network_want,
+            network_want=pop.network_want,
+            transport=pop.transport,
         )
         attach_obs(node, metrics, tracer)
-        network[pk] = node.ask_sync
-        network_want[pk] = node.ask_events
+        pop.network[pk] = node.ask_sync
+        pop.network_want[pk] = node.ask_events
         nodes.append(node)
-    sim = Simulation(config=config, nodes=nodes, network=network, rng=rng, clock=clock)
+    sim = Simulation(
+        config=config, nodes=nodes, network=pop.network, rng=pop.rng,
+        clock=clock, transport=pop.transport,
+    )
     # shared logical clock advances every turn so timestamps vary
     orig_step = sim.step
 
@@ -194,11 +250,18 @@ def run_with_forkers(
     fork_every: int = 7,
     metrics=None,
     tracer=None,
+    transport_factory: Optional[Callable] = None,
 ) -> Simulation:
     """Config-4-style run: honest gossip with periodic fork injection.
     ``metrics=`` / ``tracer=`` as in :func:`make_simulation` — fork-pair
-    detections land in ``gossip_fork_pairs_detected``."""
-    sim = make_simulation(n_nodes, seed=seed, metrics=metrics, tracer=tracer)
+    detections land in ``gossip_fork_pairs_detected``.  The adversary
+    injects forks into its own store, so fork *propagation* rides
+    whatever transport the sim was built with — pass a faulty
+    ``transport_factory`` to compose byzantine + network faults."""
+    sim = make_simulation(
+        n_nodes, seed=seed, metrics=metrics, tracer=tracer,
+        transport_factory=transport_factory,
+    )
     adversary = ForkingAdversary(sim, list(range(n_forkers)), fork_every)
     for _ in range(n_turns):
         sim.step()
@@ -231,14 +294,19 @@ class DivergentForker:
         config: SwirldConfig,
         clock: Callable[[], int],
         rng: random.Random,
+        transport: Optional[Transport] = None,
     ):
         self.pk = pk
         self.sk = sk
         self.rng = rng
+        # the branch nodes ride the same transport as honest members, so
+        # byzantine equivocation composes with injected network faults
+        # (drops/partitions hit the forker's pulls too)
         self.branches = [
             Node(
                 sk=sk, pk=pk, network=network, members=members,
                 config=config, clock=clock, network_want=network_want,
+                transport=transport,
             )
             for _ in range(2)
         ]
@@ -293,6 +361,7 @@ class DivergentSimulation:
     rng: random.Random
     clock: List[int]
     members: List[bytes]
+    transport: Optional[Transport] = None
 
 
 def run_with_divergent_forkers(
@@ -305,6 +374,7 @@ def run_with_divergent_forkers(
     on_turn: Optional[Callable[[int, List[Node]], None]] = None,
     metrics=None,
     tracer=None,
+    transport_factory: Optional[Callable] = None,
 ) -> DivergentSimulation:
     """Config-4 adversary model: ``n_forkers`` equivocating members serving
     divergent branches; honest nodes must stay live and prefix-consistent
@@ -315,21 +385,23 @@ def run_with_divergent_forkers(
     runs after every gossip turn (checkpoint hooks, assertions, ...).
     ``metrics=`` / ``tracer=`` (see :func:`attach_obs`) instrument the
     *honest* nodes — the adversary's branch nodes stay unobserved.
+    ``transport_factory`` as in :func:`make_simulation`: honest nodes AND
+    the forkers' branch nodes all route through the one transport, so
+    byzantine and network faults compose in one scenario.
     """
     config = SwirldConfig(n_members=n_nodes, seed=seed)
-    rng = random.Random(seed)
-    keys = [crypto.keypair(b"member-%d-%d" % (seed, i)) for i in range(n_nodes)]
-    members = [pk for pk, _ in keys]
-    network: Dict[bytes, Callable] = {}
-    network_want: Dict[bytes, Callable] = {}
-    clock = [0]
+    pop = build_population(n_nodes, seed, transport_factory)
+    rng, members, clock = pop.rng, pop.members, pop.clock
+    network, network_want, transport = (
+        pop.network, pop.network_want, pop.transport
+    )
     forkers: List[DivergentForker] = []
     honest: List[Node] = []
-    for i, (pk, sk) in enumerate(keys):
+    for i, (pk, sk) in enumerate(pop.keys):
         if i < n_forkers:
             f = DivergentForker(
                 sk, pk, members, network, network_want, config,
-                lambda: clock[0], rng,
+                lambda: clock[0], rng, transport=transport,
             )
             network[pk] = f.ask_sync
             network_want[pk] = f.ask_events
@@ -339,7 +411,7 @@ def run_with_divergent_forkers(
             node = Node(
                 sk=sk, pk=pk, network=network, members=members,
                 config=cfg_i, clock=lambda: clock[0],
-                network_want=network_want,
+                network_want=network_want, transport=transport,
             )
             attach_obs(node, metrics, tracer)
             network[pk] = node.ask_sync
@@ -360,7 +432,7 @@ def run_with_divergent_forkers(
             on_turn(turn, honest)
     return DivergentSimulation(
         config=config, nodes=honest, forkers=forkers, network=network,
-        rng=rng, clock=clock, members=members,
+        rng=rng, clock=clock, members=members, transport=transport,
     )
 
 

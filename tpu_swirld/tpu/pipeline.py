@@ -1629,6 +1629,8 @@ class IncrementalConsensus:
         prune_min: Optional[int] = None,
         matmul_dtype_name: Optional[str] = None,
         ssm_cols_fn=None,
+        storm_threshold: int = 3,
+        storm_cooldown: int = 8,
     ):
         if stake is None:
             stake = [1] * len(members)
@@ -1688,6 +1690,23 @@ class IncrementalConsensus:
         self.rebases = 0
         self.recompiles_hint = 0
 
+        # rebase-storm guard: adversarial ingest (straggler floods, deep
+        # orphan replays) can make EVERY pass detect-then-rebase, paying
+        # the doomed incremental attempt on top of the full recompute.
+        # After `storm_threshold` consecutive detected rebases the driver
+        # flips to full-recompute mode for `storm_cooldown` passes
+        # (skipping the extension attempt entirely), then re-admits the
+        # incremental path with a fresh slate — a hysteresis loop, so
+        # thrash can't oscillate pass-by-pass.  storm_threshold <= 0
+        # disables the guard (the thrash-measuring control in tests).
+        self.storm_threshold = storm_threshold
+        self.storm_cooldown = max(1, storm_cooldown)
+        self.storm_entries = 0            # times the guard engaged
+        self.storm_rebases = 0            # rebases run in storm mode
+        self.max_consecutive_rebases = 0  # worst detect-rebase streak
+        self._consec_rebases = 0
+        self._storm_left = 0
+
     # -------------------------------------------------------- public API
 
     def __len__(self) -> int:
@@ -1700,6 +1719,12 @@ class IncrementalConsensus:
     @property
     def pruned_prefix(self) -> int:
         return self._lo
+
+    @property
+    def storm_mode(self) -> bool:
+        """True while the rebase-storm guard holds the driver in
+        full-recompute mode."""
+        return self._storm_left > 0
 
     def ingest(self, events=()) -> Dict:
         """Feed a topo-ordered gossip delta; run one incremental pass.
@@ -1714,7 +1739,22 @@ class IncrementalConsensus:
         n_new = n_total - self._n_done
         if n_total == 0 or (n_new == 0 and self._initialized):
             return self._stats(n_new, [], t0, rebased=False)
-        if not self._initialized or self._needs_rebase_pre():
+        if not self._initialized:
+            # the cold-start build is a rebase mechanically but not a
+            # *failed incremental attempt* — it never feeds the guard
+            ordered = self._rebase()
+            return self._stats(n_new, ordered, t0, rebased=True,
+                               count_storm=False)
+        if self._storm_left > 0:
+            # storm mode: skip the doomed detect/extend attempt outright
+            self._storm_left -= 1
+            self.storm_rebases += 1
+            if self._storm_left == 0:
+                self._consec_rebases = 0   # hysteresis exit: fresh slate
+            ordered = self._rebase()
+            return self._stats(n_new, ordered, t0, rebased=True,
+                               count_storm=False, storm=True)
+        if self._needs_rebase_pre():
             ordered = self._rebase()
             return self._stats(n_new, ordered, t0, rebased=True)
         ordered, need_rebase = self._extend_pass(n_new)
@@ -1750,30 +1790,58 @@ class IncrementalConsensus:
                 "rebases": self.rebases,
                 "window_size": self.window_size,
                 "pruned_prefix": self.pruned_prefix,
+                "storm_entries": self.storm_entries,
+                "storm_rebases": self.storm_rebases,
+                "max_consecutive_rebases": self.max_consecutive_rebases,
             },
         )
 
     # ------------------------------------------------------ pass plumbing
 
-    def _stats(self, n_new, ordered, t0, *, rebased):
+    def _stats(self, n_new, ordered, t0, *, rebased,
+               count_storm=True, storm=False):
         self.passes += 1
         if rebased:
             self.rebases += 1
+            if count_storm:
+                # a *detected* rebase: an incremental attempt that failed
+                self._consec_rebases += 1
+                self.max_consecutive_rebases = max(
+                    self.max_consecutive_rebases, self._consec_rebases
+                )
+                if (
+                    self.storm_threshold > 0
+                    and self._consec_rebases >= self.storm_threshold
+                ):
+                    self.storm_entries += 1
+                    self._storm_left = self.storm_cooldown
+        elif n_new > 0:
+            self._consec_rebases = 0   # a clean incremental pass
+        # a storm-mode pass must report as such even when it was the last
+        # one of the cooldown (_storm_left was decremented before _stats)
+        in_storm = storm or self._storm_left > 0
         o = obs.current()
         if o is not None:
             g = o.registry
             g.gauge("incremental_window_size").set(self.window_size)
             g.gauge("incremental_pruned_prefix").set(self.pruned_prefix)
             g.gauge("incremental_r_base").set(self._r_base)
+            g.gauge("incremental_storm_mode").set(1.0 if in_storm else 0.0)
+            g.gauge("incremental_consecutive_rebases").set(
+                self._consec_rebases
+            )
             g.counter("incremental_passes_total").inc()
             if rebased:
                 g.counter("incremental_rebases_total").inc()
+            if storm:
+                g.counter("incremental_storm_rebases_total").inc()
         return {
             "new_events": int(n_new),
             "ordered": ordered,
             "window_size": self.window_size,
             "pruned_prefix": self.pruned_prefix,
             "rebased": bool(rebased),
+            "storm_mode": in_storm,
             "seconds": round(time.perf_counter() - t0, 6),
         }
 
