@@ -174,6 +174,57 @@ def test_chaos_run_cli_smoke(tmp_path):
     assert "transport_drops_total" in r2.stdout
 
 
+# ------------------------------------------------- horizon / overflow storms
+
+
+@pytest.mark.chaos
+def test_horizon_storm_all_engines_bit_identical(tmp_path):
+    """The acceptance scenario for the deterministic expiry horizon: a
+    minority member signs against its stale view through a partition; at
+    heal its straggler tail lands below the majority's committed frontier.
+    Every honest node must register the stragglers identically and the
+    probe node's live state must be bit-identical to a batch device replay
+    and an incremental drive — the history the old node-local quarantine
+    excluded from parity suites entirely."""
+    from tpu_swirld.chaos import run_horizon_storm
+
+    v = run_horizon_storm(str(tmp_path))
+    h = v["horizon"]
+    assert h["late_witnesses"] > 0, "the straggler corner must actually fire"
+    assert h["horizon_violations"] == 0
+    assert h["batch_oracle_parity"]
+    assert h["incremental_batch_parity"]
+    assert v["safety"]["prefix_agree"] and v["safety"]["oracle_agree"]
+    assert v["liveness"]["advanced_after_heal"]
+    assert v["ok"], v
+
+
+@pytest.mark.chaos
+def test_overflow_storm_cli_selfheals_with_parity(tmp_path):
+    """scripts/chaos_run.py --scenario overflow_storm: both self-healing
+    legs (fork-storm s_max doubling, round-clamp unclamped retry) complete
+    with parity and an ok JSON verdict."""
+    import importlib.util
+    import json
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "chaos_run", os.path.join(os.path.dirname(__file__), "..",
+                                  "scripts", "chaos_run.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = tmp_path / "overflow_verdict.json"
+    rc = mod.main(["--scenario", "overflow_storm", "--out", str(out)])
+    assert rc == 0
+    v = json.loads(out.read_text())
+    assert v["fork_storm"]["overflow_retries"] >= 1
+    assert v["fork_storm"]["parity"]
+    assert v["round_clamp"]["overflow_retries"] >= 1
+    assert v["round_clamp"]["parity"]
+    assert v["ok"], v
+
+
 # ------------------------------------------------------ rebase-storm guard
 
 
@@ -285,6 +336,39 @@ def test_checkpoint_packed_roundtrip_into_incremental_pipeline(tmp_path):
     save_packed(path, inc.packer.pack())
     restored = load_packed(path)
     assert_same_result(inc.result(), run_consensus(restored, cfg, block=64))
+
+
+def test_checkpoint_horizon_digest_verified_on_restore(tmp_path):
+    """save_node embeds the decided-prefix digest; load_node must verify
+    the replay re-decides that exact prefix, and fail LOUDLY on a
+    tampered checkpoint instead of resuming from diverged state."""
+    import json
+    import struct
+
+    from tpu_swirld.sim import make_simulation
+
+    sim = make_simulation(3, seed=21)
+    sim.run(80)
+    node = sim.nodes[0]
+    assert len(node.consensus) > 0
+    path = str(tmp_path / "n.swck")
+    save_node(path, node)
+    restored = load_node(path, sk=node.sk, pk=node.pk, network={})
+    assert restored.consensus == node.consensus
+    assert restored._frozen_round == node._frozen_round
+
+    # tamper with the recorded digest -> restore must refuse
+    with open(path, "rb") as f:
+        data = f.read()
+    (hlen,) = struct.unpack_from("<I", data, 4)
+    meta = json.loads(data[8 : 8 + hlen].decode())
+    meta["order_digest"] = "00" * 32
+    header = json.dumps(meta).encode()
+    with open(path, "wb") as f:
+        f.write(b"SWCK" + struct.pack("<I", len(header)) + header
+                + data[8 + hlen:])
+    with pytest.raises(ValueError, match="diverged"):
+        load_node(path, sk=node.sk, pk=node.pk, network={})
 
 
 def test_checkpoint_node_restore_preserves_resilience_surface(tmp_path):

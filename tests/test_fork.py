@@ -135,18 +135,24 @@ def test_strongly_sees_exists_z_rule_on_fork_dag():
     assert not node.strongly_sees(x1, gA)
 
 
-def test_straggler_witness_quarantined_not_crash():
-    """A witness landing in a fame-complete (frozen) round must be
-    quarantined, not kill the node (VERDICT r4 weak #2)."""
+def test_straggler_witness_registers_deterministically():
+    """A witness landing in a fame-complete (frozen) round is a FULL
+    citizen under the deterministic expiry horizon: registered in the
+    witness tables (so every node and engine computes the identical
+    state regardless of arrival order), and tracked as metadata in
+    late_witnesses for observability."""
     keys, members, node = _manual_population()
     node._frozen_round = 0  # pretend round 0 fame is complete
     pkA, skA = keys[0]
     ev = Event(d=b"", p=(), t=5, c=pkA).signed(skA)
     node.add_event(ev)
     node.divide_rounds([ev.id])   # genesis witness in frozen round 0
-    assert ev.id in node.ancient
     assert node.is_witness[ev.id]
-    assert ev.id not in node.wit_slot
+    assert ev.id in node.wit_slot, "late witness must enter the table"
+    assert ev.id in node.wit_list[0]
+    assert node.famous[ev.id] is None   # undecided until votes exist
+    assert ev.id in node.late_witnesses
+    assert node.horizon_violations == 0
 
 
 def test_divergent_forker_no_crash_and_convergence():
@@ -168,6 +174,44 @@ def test_divergent_forker_no_crash_and_convergence():
     ), "divergent branches never met — adversary too weak"
     # and recovery actually exercised the orphan path at least once
     # (divergent suffixes necessarily produce unknown-parent deliveries)
+
+
+def test_forked_creator_sync_replies_stay_o_delta():
+    """Once a creator is known to have forked, sync replies must NOT
+    re-send its whole history forever: the reply is the height-hint delta
+    plus a bounded fork digest (earliest fork-group siblings + branch
+    tips), and a converged asker gets an O(1)-sized reply even while the
+    persistent equivocator keeps growing its branches."""
+    from tpu_swirld import crypto
+    from tpu_swirld.sim import run_with_divergent_forkers
+
+    sim = run_with_divergent_forkers(5, 1, 260, seed=5)
+    forker_pk = sim.forkers[0].pk
+    server = next(n for n in sim.nodes if n.has_fork[forker_pk])
+    asker = next(n for n in sim.nodes if n is not server)
+    # converge the asker to the server's store
+    for _ in range(12):
+        got = asker.pull(server.pk)
+        if got:
+            asker.consensus_pass(got)
+        elif not asker._orphans:
+            break
+    n_forker_events = len(server.member_events[forker_pk])
+    assert n_forker_events >= 20, "equivocator must have a long history"
+
+    hv = b"".join(
+        len(asker.member_events[m]).to_bytes(4, "little")
+        for m in asker.members
+    )
+    req = hv + crypto.sign(hv, asker.sk, crypto.DOMAIN_SYNC_REQ)
+    reply = server.ask_sync(asker.pk, req)
+    events = asker._decode_signed_blob(reply, server.pk)
+    assert events is not None
+    # old rule: every sync re-shipped all n_forker_events forker events.
+    # new rule: delta (empty here) + first fork-group siblings + tips.
+    bound = 2 + len(server.branch_tips[forker_pk]) + 4
+    assert len(events) <= bound < n_forker_events
+    assert len(reply) < n_forker_events * 100  # bytes, not just counts
 
 
 def test_orphan_buffer_requeues_unknown_parent():

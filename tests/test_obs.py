@@ -263,7 +263,8 @@ def test_node_gauges_tolerates_partial_nodes():
 
     g = node_gauges(Husk())
     assert g["events"] == 0 and g["orphans_parked"] == 0
-    assert g["forks_detected"] == 0 and g["ancient_quarantined"] == 0
+    assert g["forks_detected"] == 0 and g["late_witnesses"] == 0
+    assert g["horizon_violations"] == 0
 
     sim = make_simulation(4, seed=2)
     sim.run(60)

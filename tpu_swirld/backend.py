@@ -54,6 +54,9 @@ class TpuEngine:
             self.mesh = make_mesh(n_dev)
         self.last_result: Optional[ConsensusResult] = None
         self._n_consumed = 0
+        self._violations_seen: set = set()  # famous late witnesses already
+        #   counted into node.horizon_violations (fame may decide on a
+        #   LATER pass than the one that registered the witness)
 
     def consensus_pass(self, new_ids: List[bytes], force: bool = False) -> None:
         node = self.node
@@ -84,9 +87,18 @@ class TpuEngine:
         self.consensus_pass([], force=True)
 
     def _write_back(self, packed, result: ConsensusResult) -> None:
-        """Mirror device outputs into the node's oracle-shaped state."""
+        """Mirror device outputs into the node's oracle-shaped state.
+
+        The deterministic expiry horizon (see the oracle module docstring)
+        registers every witness on both engines, so the write-back is a
+        plain overwrite; for observability parity with python-backend
+        nodes, witnesses that landed at or below the previously committed
+        frontier are recorded in ``node.late_witnesses``.
+        """
         node = self.node
         ids = packed.ids
+        prev_frozen = node._frozen_round
+        prev_wits = set(node.wit_slot)
         node.round = {ids[i]: int(result.round[i]) for i in range(packed.n)}
         node.is_witness = {
             ids[i]: bool(result.is_witness[i]) for i in range(packed.n)
@@ -108,6 +120,20 @@ class TpuEngine:
             node.witnesses.setdefault(r, {}).setdefault(
                 node.hg[eid].c, []
             ).append(eid)
+            if r <= prev_frozen and eid not in prev_wits:
+                node.late_witnesses.append(eid)
+                if node.metrics is not None:
+                    node.metrics.count("consensus_late_witnesses")
+        # same contract as the oracle path: a FAMOUS late witness
+        # (impossible under n > 3f) is surfaced, never silently absorbed.
+        # Checked over ALL known late witnesses every pass — fame may
+        # decide on a later pass than the one that registered the witness.
+        for eid in node.late_witnesses:
+            if node.famous.get(eid) and eid not in self._violations_seen:
+                self._violations_seen.add(eid)
+                node.horizon_violations += 1
+                if node.metrics is not None:
+                    node.metrics.count("consensus_horizon_violations")
         # ordering state
         node.round_received = {}
         node.consensus_ts = {}

@@ -35,7 +35,12 @@ The run produces a **verdict** dict asserting the two protocol claims:
 
 Scenarios are reproducible from ``(scenario.seed, plan.seed)``:
 ``scripts/chaos_run.py`` is the CLI front end and
-``tests/test_chaos.py`` pins the acceptance scenario.
+``tests/test_chaos.py`` pins the acceptance scenario.  Two named storm
+scenarios ride the same machinery: :func:`run_horizon_storm` (straggler
+witnesses across a healing partition — the deterministic expiry horizon's
+acceptance gate, with a cross-engine bit-parity verdict) and
+:func:`run_overflow_storm` (witness-table self-healing: fork-storm slot
+doubling and the unclamped round-window retry).
 """
 
 from __future__ import annotations
@@ -49,7 +54,7 @@ from tpu_swirld.config import SwirldConfig
 from tpu_swirld.oracle.graph import toposort
 from tpu_swirld.oracle.node import Node
 from tpu_swirld.sim import DivergentForker, attach_obs, build_population
-from tpu_swirld.transport import FaultPlan, FaultyTransport
+from tpu_swirld.transport import FaultPlan, FaultyTransport, Partition
 
 
 @dataclasses.dataclass
@@ -86,6 +91,7 @@ class ChaosSimulation:
         ckpt_dir: str,
         metrics=None,
         tracer=None,
+        on_turn: Optional[Callable[[int, "ChaosSimulation"], None]] = None,
     ):
         sc = scenario
         heal = sc.plan.heal_time()
@@ -134,6 +140,7 @@ class ChaosSimulation:
                 self.forkers.append(f)
             else:
                 self.nodes[i] = self._make_node(i)
+        self.on_turn = on_turn
         self.crashes = 0
         self.restarts = 0
         # own-event WAL: the durable log of each member's self-signed
@@ -269,6 +276,8 @@ class ChaosSimulation:
                     f.step(honest_pks)
             if turn == self._heal_t:
                 self._decided_at_heal = self._min_decided()
+            if self.on_turn is not None:
+                self.on_turn(turn, self)
         # any member still down at the end comes back for the verdict
         for idx, node in list(self.nodes.items()):
             if node is None:
@@ -354,6 +363,12 @@ class ChaosSimulation:
                 "quarantined_member_indices": quarantined,
                 "forks_detected": max(n.forks_detected for n in nodes),
                 "orphans_parked": sum(n.orphans_parked for n in nodes),
+                "late_witnesses": sum(
+                    len(n.late_witnesses) for n in nodes
+                ),
+                "horizon_violations": sum(
+                    n.horizon_violations for n in nodes
+                ),
             },
             "scenario": {
                 "seed": self.scenario.seed,
@@ -372,3 +387,225 @@ def run_chaos(
     return ChaosSimulation(
         scenario, ckpt_dir, metrics=metrics, tracer=tracer
     ).run()
+
+
+# ------------------------------------------------- named storm scenarios
+#
+# The two storm scenarios below pin the PR-4 robustness obligations as
+# reproducible JSON verdicts (scripts/chaos_run.py --scenario ...):
+#
+# - horizon_storm: straggler witnesses fired mid-protocol across a healing
+#   partition must land below the committed frontier on the majority side
+#   and still leave every engine — live oracle, batch device replay,
+#   incremental driver — bit-identical (the deterministic expiry horizon).
+# - overflow_storm: witness-table capacity misses (fork-storm slot
+#   exhaustion, round-window under-provisioning) must self-heal via the
+#   auto-retry instead of fail-stopping, with parity preserved.
+
+
+def _engines_agree(node) -> Dict:
+    """Cross-engine agreement for one node's full DAG: live oracle state
+    vs a cold batch ``run_consensus`` vs an ``IncrementalConsensus`` drive
+    over chunked ingest.  Returns comparison booleans (all pure-function
+    replays of the same DAG, so anything but bit-equality is a bug)."""
+    from tpu_swirld.packing import pack_node
+    from tpu_swirld.tpu.pipeline import IncrementalConsensus, run_consensus
+
+    packed = pack_node(node)
+    batch = run_consensus(packed, node.config, block=64)
+    oracle_famous = {
+        node.idx[w]: node.famous[w]
+        for r, ws in node.wit_list.items()
+        for w in ws
+    }
+    batch_oracle = (
+        all(
+            int(batch.round[i]) == node.round[eid]
+            and bool(batch.is_witness[i]) == bool(node.is_witness[eid])
+            for i, eid in enumerate(node.order_added)
+        )
+        and batch.famous == oracle_famous
+        and [packed.ids[i] for i in batch.order] == node.consensus
+    )
+    events = [node.hg[e] for e in node.order_added]
+    stake = [node.stake[m] for m in node.members]
+    inc = IncrementalConsensus(
+        node.members, stake, node.config, block=64, chunk=64,
+        window_bucket=256, prune_min=64,
+    )
+    for i in range(0, len(events), 64):
+        inc.ingest(events[i : i + 64])
+    res = inc.result()
+    inc_batch = (
+        (res.round == batch.round).all()
+        and (res.is_witness == batch.is_witness).all()
+        and res.famous == batch.famous
+        and res.order == batch.order
+        and (res.round_received == batch.round_received).all()
+        and (res.consensus_ts == batch.consensus_ts).all()
+    )
+    return {
+        "batch_oracle_parity": bool(batch_oracle),
+        "incremental_batch_parity": bool(inc_batch),
+        "incremental_rebases": inc.rebases,
+    }
+
+
+def horizon_storm_scenario(seed: int = 1, n_turns: int = 260) -> ChaosScenario:
+    """Partition one member into a minority for the middle of the run: it
+    keeps signing against its stale view (rounds frozen — a minority can
+    never promote), the majority supermajority keeps ordering rounds, and
+    at heal the straggler tail floods in below the committed frontier."""
+    plan = FaultPlan(
+        seed=seed,
+        partitions=[
+            Partition(start=n_turns // 4, end=(2 * n_turns) // 3, group=(4,))
+        ],
+    )
+    return ChaosScenario(
+        n_nodes=5, n_turns=n_turns, seed=seed, n_forkers=0, plan=plan,
+        checkpoint_every=50,
+    )
+
+
+def run_horizon_storm(ckpt_dir: str, seed: int = 1, metrics=None,
+                      tracer=None) -> Dict:
+    """Run the straggler-witness scenario and extend the verdict with the
+    horizon section: late-witness counts and cross-engine agreement.  The
+    old node-local quarantine made exactly this history a documented
+    divergence corner (parity suites excluded it with ``assert not
+    node.ancient``); the deterministic horizon must decide it
+    bit-identically on every node and engine.
+
+    Two straggler sources compose: the partitioned member's own stale
+    tail (natural), and a deterministic post-heal injection of forged
+    straggler witnesses deep below the majority frontier (the shape an
+    amnesiac or equivocating laggard produces) — so the corner fires on
+    every run, not just lucky seeds."""
+    from tpu_swirld.sim import make_straggler_event
+
+    scenario = horizon_storm_scenario(seed)
+    inject_t = scenario.plan.heal_time() + 10
+    iso = scenario.plan.partitions[0].group[0]
+    injected: List[bytes] = []
+
+    def _fire_stragglers(turn: int, sim: "ChaosSimulation") -> None:
+        if turn != inject_t or injected:
+            return
+        pk, sk = sim.keys[iso]
+        target = next(
+            n for i, n in sim.nodes.items() if n is not None and i != iso
+        )
+        try:
+            ev = make_straggler_event(target, pk, sk, at_round=1)
+        except ValueError:
+            return
+        new_ids: List[bytes] = []
+        target._ingest([ev], new_ids)
+        if new_ids:
+            target.consensus_pass(new_ids)
+            injected.extend(new_ids)
+
+    sim = ChaosSimulation(
+        scenario, ckpt_dir, metrics=metrics, tracer=tracer,
+        on_turn=_fire_stragglers,
+    )
+    verdict = sim.run()
+    nodes = sim._live_honest()
+    late = sum(len(n.late_witnesses) for n in nodes)
+    violations = sum(n.horizon_violations for n in nodes)
+    probe = max(nodes, key=lambda n: len(n.hg))
+    engines = _engines_agree(probe)
+    verdict["horizon"] = {
+        "late_witnesses": late,
+        "horizon_violations": violations,
+        **engines,
+    }
+    verdict["ok"] = bool(
+        verdict["ok"]
+        and late > 0                       # the corner actually fired
+        and violations == 0
+        and engines["batch_oracle_parity"]
+        and engines["incremental_batch_parity"]
+    )
+    return verdict
+
+
+def run_overflow_storm(seed: int = 4) -> Dict:
+    """Device-engine self-healing verdict, two legs:
+
+    - *fork storm*: a heavily equivocating DAG run with a deliberately
+      under-provisioned witness-slot capacity (``s_max``) — previously a
+      fail-stop ``RuntimeError("witness table overflow")``, now a doubled-
+      ``s_max`` auto-retry that must finish with oracle parity;
+    - *round clamp*: a deep DAG run with an under-provisioned round window
+      (``r_max``) — the chain-derived clamp's failure shape — which must
+      retry unclamped at ``config.max_rounds`` and finish with parity.
+    """
+    from tpu_swirld.config import SwirldConfig
+    from tpu_swirld.oracle.node import Node as _Node
+    from tpu_swirld.packing import pack_events, pack_node
+    from tpu_swirld.sim import generate_gossip_dag, make_simulation
+    from tpu_swirld.tpu.pipeline import run_consensus
+
+    def _oracle_parity(packed_dag, result, oracle_node) -> bool:
+        """Shared parity predicate for both storm legs (keep in lock-step:
+        order AND per-event rounds must match the oracle exactly)."""
+        return bool(
+            [packed_dag.ids[i] for i in result.order] == oracle_node.consensus
+            and all(
+                int(result.round[i]) == oracle_node.round[eid]
+                for i, eid in enumerate(oracle_node.order_added)
+            )
+        )
+
+    members, stake, events, keys = generate_gossip_dag(
+        8, 500, seed=seed, n_forkers=3, fork_prob=0.4
+    )
+    packed = pack_events(events, members, stake)
+    oracle = _Node(
+        sk=keys[0][1], pk=members[0], network={}, members=members,
+        clock=lambda: 0, create_genesis=False,
+        config=SwirldConfig(n_members=8),
+    )
+    new_ids = [ev.id for ev in events if oracle.add_event(ev)]
+    oracle.consensus_pass(new_ids)
+    res_a = run_consensus(
+        packed, oracle.config, block=64, s_max=len(members) + 1
+    )
+    fork_leg = {
+        "fork_pairs": int(packed.fork_pairs.shape[0]),
+        "overflow_retries": int(res_a.timings.get("overflow_retries", 0)),
+        "parity": _oracle_parity(packed, res_a, oracle),
+    }
+
+    # rotating-stake population: unequal stakes make the >2/3 witness
+    # quorum rotate among weighted subsets round to round.  (A DAG whose
+    # max_round NATURALLY exceeds the chain clamp is provably impossible:
+    # every promoted round needs witnesses from >2/3 of stake, so some
+    # member witnesses — and therefore chains — at least ~2/3 of all
+    # rounds, and the visibility echo each promotion needs pushes the
+    # longest chain past max_round.  The clamp's failure shape is an
+    # under-provisioned explicit r_max, which is what this leg drives.)
+    cfg_b = SwirldConfig(n_members=5, stake=(3, 2, 2, 1, 1), seed=seed)
+    sim = make_simulation(5, seed=seed, config=cfg_b)
+    sim.run(320)
+    node = sim.nodes[0]
+    packed_b = pack_node(node)
+    res_b = run_consensus(packed_b, node.config, block=64, r_max=8)
+    clamp_leg = {
+        "max_round": int(res_b.max_round),
+        "overflow_retries": int(res_b.timings.get("overflow_retries", 0)),
+        "parity": _oracle_parity(packed_b, res_b, node),
+    }
+    ok = bool(
+        fork_leg["parity"] and fork_leg["overflow_retries"] >= 1
+        and clamp_leg["parity"] and clamp_leg["overflow_retries"] >= 1
+        and clamp_leg["max_round"] >= 8
+    )
+    return {
+        "ok": ok,
+        "fork_storm": fork_leg,
+        "round_clamp": clamp_leg,
+        "scenario": {"seed": seed, "name": "overflow_storm"},
+    }

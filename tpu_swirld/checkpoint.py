@@ -13,6 +13,17 @@ process; the build owes save/restore.  Two granularities:
   the purity of the consensus functions reconstructs bit-identical
   ``round`` / ``witness`` / ``famous`` / order state; the node then
   resumes gossiping.
+
+The replay-purity contract is only sound because the expiry horizon is
+deterministic (``tpu_swirld.oracle.node`` module docstring): under the old
+node-local quarantine, a node that had quarantined a straggler witness
+would replay its own checkpoint WITHOUT the quarantine (the batch replay
+never freezes mid-pass) and restart disagreeing with its pre-crash self.
+With the deterministic rule the horizon survives restart by construction;
+the checkpoint additionally carries the decided-order length and a digest
+of the decided prefix, and :func:`load_node` verifies the replay
+re-decides that exact prefix — so checkpoint corruption or consensus-rule
+drift fails loudly at restore time instead of diverging silently later.
 """
 
 from __future__ import annotations
@@ -101,6 +112,12 @@ def save_node(path: str, node: Node) -> None:
         "config": cfg,
         "members": [m.hex() for m in node.members],
         "n_events": len(node.order_added),
+        # horizon integrity: the committed frontier at save time and a
+        # digest of the decided prefix; load_node verifies the replay
+        # re-decides this exact prefix (replay purity made checkable)
+        "decided": len(node.consensus),
+        "frontier": node._frozen_round,
+        "order_digest": crypto.hash_bytes(b"".join(node.consensus)).hex(),
     }
     header = json.dumps(meta).encode()
     with open(path, "wb") as f:
@@ -152,4 +169,27 @@ def load_node(
         # a backend='tpu' node with a lazy-batch threshold must still come
         # back fully computed — the restore contract is bit-identical state
         node._tpu_engine.flush()
+    # horizon integrity (older checkpoints without the fields skip this):
+    # the replay must re-decide at least the checkpointed frontier, and
+    # the decided prefix must be byte-identical to what was saved
+    decided = int(meta.get("decided", 0))
+    digest = meta.get("order_digest")
+    if digest is not None:
+        if len(node.consensus) < decided:
+            raise ValueError(
+                f"checkpoint replay regressed the horizon: re-decided "
+                f"{len(node.consensus)} < checkpointed {decided}"
+            )
+        frontier = int(meta.get("frontier", node._frozen_round))
+        if node._frozen_round < frontier:
+            raise ValueError(
+                f"checkpoint replay regressed the frontier: re-froze "
+                f"round {node._frozen_round} < checkpointed {frontier}"
+            )
+        got = crypto.hash_bytes(b"".join(node.consensus[:decided])).hex()
+        if got != digest:
+            raise ValueError(
+                "checkpoint replay diverged from the saved decided prefix "
+                "(corrupt checkpoint or consensus-rule drift)"
+            )
     return node

@@ -47,6 +47,12 @@ class SwirldConfig:
                                  # (count cap alone admits ~4 GiB of
                                  # max-payload events from one signer)
     max_want_rounds: int = 32    # want-list round-trips per sync
+    want_ancestor_depth: int = 64  # ask_events ships, per wanted event, a
+                                 # self-ancestor chain of up to this many
+                                 # events (the wanted event included), so
+                                 # one successful want round-trip closes a
+                                 # whole chain gap (not one parent level)
+                                 # — deep-orphan recovery under loss
     tpu_min_batch: int = 1       # backend='tpu': min new events per device
                                  # pass (higher amortizes the batch replay;
                                  # consensus output is identical, delayed)

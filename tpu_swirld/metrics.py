@@ -115,7 +115,8 @@ def node_gauges(
         "decided_round_lag": max_round - getattr(node, "consensus_round", 0),
         "undecided_witnesses": undecided,
         "orphans_parked": getattr(node, "orphans_parked", 0),
-        "ancient_quarantined": len(getattr(node, "ancient", ())),
+        "late_witnesses": len(getattr(node, "late_witnesses", ())),
+        "horizon_violations": getattr(node, "horizon_violations", 0),
         "forks_detected": getattr(node, "forks_detected", 0),
         "bad_replies": getattr(node, "bad_replies", 0),
         "bad_requests": getattr(node, "bad_requests", 0),

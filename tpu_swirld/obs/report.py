@@ -78,11 +78,16 @@ _RESILIENCE_PREFIXES = (
     "gossip_bad_",
     "incremental_storm",
     "incremental_consecutive_rebases",
+    "consensus_late_witnesses",
+    "consensus_horizon_violations",
+    "pipeline_overflow_retries",
     "node_bad_",
     "node_retries",
     "node_backoff",
     "node_quarantined",
     "node_circuit",
+    "node_late_witnesses",
+    "node_horizon_violations",
 )
 
 

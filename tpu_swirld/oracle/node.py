@@ -51,6 +51,25 @@ here so both backends agree):
 - *final order*: sort by (round received, consensus timestamp,
   ``BLAKE2b(whiten || id)``) where ``whiten`` is the XOR of the unique
   famous witnesses' signatures.
+- *expiry horizon* (the deterministic ancient-event rule): an event is
+  expired iff its round is at or below the fame-complete frontier of **its
+  own ancestry** — a pure function of the DAG, so every node and every
+  engine (oracle, batch pipeline, incremental driver) applies the identical
+  cut.  Because ``round`` is monotone along ancestry (``r = max(parent
+  rounds)`` plus promotion), the frontier of ``ancestry(x)`` is at most
+  ``round(x) - 2`` (fame of round ``r`` needs a round-``r+2`` witness), so
+  the deterministic cut **provably never fires** on a valid event.  The
+  operational consequence: a witness is ALWAYS registered, no matter how
+  late it arrives relative to this node's local commit progress.  Late
+  arrivals into already-ordered rounds are tracked in
+  :attr:`Node.late_witnesses` (observability only — they are full DAG
+  citizens, ride sync replies like any event, and are decided not-famous
+  by the existing vote structure whenever fewer than 1/3 of stake is
+  equivocating; a late witness decided *famous* is flagged in
+  :attr:`Node.horizon_violations` as an outside-BFT-model event).  This
+  replaces the old node-local quarantine, whose cut depended on arrival
+  timing and could make honest nodes permanently disagree on a round's
+  unique-famous-witness set.
 """
 
 from __future__ import annotations
@@ -160,6 +179,7 @@ class Node:
         self.member_events: Dict[bytes, List[bytes]] = {m: [] for m in members}
         self.member_chain: Dict[bytes, List[bytes]] = {m: [] for m in members}
         self.by_seq: Dict[bytes, Dict[int, List[bytes]]] = {m: {} for m in members}
+        self.branch_tips: Dict[bytes, set] = {m: set() for m in members}
         self.fork_groups: Dict[bytes, Dict[int, List[bytes]]] = {m: {} for m in members}
         self.has_fork: Dict[bytes, bool] = {m: False for m in members}
         self._forkseen_memo: Dict[Tuple[bytes, bytes], bool] = {}
@@ -172,7 +192,11 @@ class Node:
         self.wit_list: Dict[int, List[bytes]] = {}                # r -> slot-ordered ids
         self.wit_slot: Dict[bytes, int] = {}                      # witness id -> slot
         self._ss_memo: Dict[Tuple[bytes, bytes], bool] = {}
-        self.ancient: List[bytes] = []   # quarantined straggler witnesses
+        self.late_witnesses: List[bytes] = []  # witnesses that landed below
+        #   the committed frontier (registered anyway — see the module
+        #   docstring's expiry-horizon rule; metadata only)
+        self.horizon_violations = 0  # late witnesses later decided FAMOUS
+        #   (impossible under the n > 3f model; counted, never hidden)
         self.max_round = 0
         self.famous: Dict[bytes, Optional[bool]] = {}
         self.votes: Dict[Tuple[bytes, bytes], bool] = {}
@@ -288,6 +312,12 @@ class Node:
         s = self.seq[eid]
         self.member_mask[c] |= bit
         self.member_events[c].append(eid)
+        # branch tips: events by c that are not (yet) anyone's self-parent.
+        # Honest members keep a singleton; forked creators keep one tip per
+        # live branch (ask_sync ships them so peers can want-list gaps).
+        if ev.p:
+            self.branch_tips[c].discard(ev.p[0])
+        self.branch_tips[c].add(eid)
         group = self.by_seq[c].setdefault(s, [])
         group.append(eid)
         if len(group) == 2:
@@ -419,10 +449,15 @@ class Node:
 
         The count vector is only a *hint*: per-creator counts identify a
         chain prefix only while that creator is honest.  For creators we
-        know to have forked, we send ALL their events (forks are rare and
-        bounded by the adversary's budget); remaining gaps — e.g. forks we
-        have not detected ourselves — surface on the asker's side as
-        orphans, which it recovers via :meth:`ask_events`.
+        know to have forked, we send the delta above the count hint plus a
+        bounded fork digest — the earliest fork group's siblings (the
+        minimal equivocation proof, so an asker pinned to one branch
+        always learns the fork exists) and the current branch tips (so its
+        want-list can walk any branch it is missing).  Remaining gaps
+        surface on the asker's side as orphans, which it recovers via
+        :meth:`ask_events`; reply bytes per sync stay O(delta) even under
+        a persistent equivocator (the old rule re-sent a forker's entire
+        history on every sync forever).
         """
         if from_pk not in self.member_index:
             raise ValueError("unknown sync peer")
@@ -447,10 +482,34 @@ class Node:
             off += 4
         missing: List[bytes] = []
         for m in self.members:
-            if self.has_fork[m]:
-                missing.extend(self.member_events[m])
-            else:
-                missing.extend(self.member_events[m][heights[m]:])
+            known = self.member_events[m]
+            if not self.has_fork[m]:
+                missing.extend(known[heights[m]:])
+                continue
+            # Forked creator: the count hint cannot identify WHICH events
+            # the asker holds (branches interleave differently per node),
+            # so ship the recent tail of EVERY branch, at least as deep as
+            # the count difference, plus the earliest fork group (the
+            # minimal equivocation proof: an asker pinned to one branch
+            # always learns the fork exists) and the branch tips.  The
+            # count difference UNDER-estimates the true gap when the asker
+            # holds branch events we lack (its surplus cancels our delta);
+            # the tips close that residue — they orphan on the asker and
+            # its want-list round-trips recover whole chain segments via
+            # ask_events' self-ancestor expansion.  O(branches * delta)
+            # per reply instead of the old O(full history).
+            miss = max(len(known) - heights[m], 0)
+            extra: set = set()
+            for tip in self.branch_tips[m]:
+                cur: Optional[bytes] = tip
+                for _ in range(miss + 1):
+                    if cur is None or cur in extra:
+                        break
+                    extra.add(cur)
+                    cur = self.hg[cur].self_parent
+            first_seq = min(self.fork_groups[m])
+            extra.update(self.fork_groups[m][first_seq])
+            missing.extend(extra)
         return self._sign_event_blob(missing)
 
     def _sign_event_blob(self, ids: List[bytes]) -> bytes:
@@ -480,8 +539,13 @@ class Node:
 
     def ask_events(self, from_pk: bytes, signed_want: bytes) -> bytes:
         """Serve a want-list: the asker requests specific event ids (orphan
-        parents it is missing); reply with those we have, topo-sorted and
-        signed.  Unknown ids are silently skipped.
+        parents it is missing); reply with those we have — each expanded
+        into its self-ancestor chain, up to ``config.want_ancestor_depth``
+        events per want (the wanted event included), so a single
+        successful round-trip closes a whole chain gap instead of one
+        parent level (events the asker already holds are idempotently
+        skipped on its side; the reply caps still bound the blob) —
+        topo-sorted and signed.  Unknown ids are silently skipped.
 
         Truncated / garbage / oversized requests (an attacker, or a lossy
         transport mangling bytes in flight) are answered with a signed
@@ -506,7 +570,30 @@ class Node:
             for i in range(0, len(payload), crypto.HASH_BYTES)
         ]
         del want[self.config.max_reply_events:]   # cap the work we do
-        have = [h for h in want if h in self.hg]
+        # Ancestor expansion is breadth-first — level 0 serves every
+        # requested id before any chain is walked deeper, so one want's
+        # deep ancestry cannot starve the others — and respects the reply
+        # cap: the blob is truncated to max_reply_events anyway, so
+        # walking further is pure attacker-amplifiable waste.
+        have: List[bytes] = []
+        seen: set = set()
+        cap = self.config.max_reply_events
+        frontier = [h for h in want if h in self.hg]
+        for _level in range(max(1, self.config.want_ancestor_depth)):
+            if not frontier or len(have) >= cap:
+                break
+            nxt: List[bytes] = []
+            for h in frontier:
+                if len(have) >= cap:
+                    break
+                if h in seen:
+                    continue
+                seen.add(h)
+                have.append(h)
+                sp = self.hg[h].self_parent
+                if sp is not None:
+                    nxt.append(sp)
+            frontier = nxt
         return self._sign_event_blob(have)
 
     def _reject_request(self) -> bytes:
@@ -782,24 +869,23 @@ class Node:
     # ------------------------------------------------------------- consensus
 
     def _register_witness(self, eid: bytes, r: int) -> None:
+        # Deterministic expiry horizon (module docstring): the only sound
+        # node-agreed cut — "expired iff below the fame-complete frontier
+        # of the event's own ancestry" — provably never fires, so EVERY
+        # witness registers, however late it lands relative to this node's
+        # commit progress.  A late registration (round at or below the
+        # already-ordered frontier) cannot change committed state: votes
+        # are memoized pure functions of fixed ancestries, no existing
+        # witness strongly sees the newcomer, and a committed round's UFW
+        # set only changes if the newcomer is decided famous — which the
+        # vote-unanimity lemma rules out below 1/3 equivocating stake
+        # (tracked in horizon_violations otherwise).  This is what keeps
+        # the live oracle, a batch replay, and every peer bit-identical
+        # regardless of arrival order.
         if r <= self._frozen_round:
-            # Ancient-horizon prune: a witness landing in a fame-complete
-            # round is quarantined — excluded from witness tables, fame
-            # voting, and promotion tallies — so the node keeps running
-            # when a lagging member's old events arrive late (fame needs
-            # only a >2/3 quorum, so this is legitimate traffic).  The
-            # horizon is a node-local cut: in the adversarial corner where
-            # such a witness would have been *pivotal* for a later event's
-            # round promotion, nodes that saw it in time may assign that
-            # event a different round.  Full-closure gossip makes that
-            # corner unreachable without >1/3 stake being partitioned
-            # (outside the BFT liveness model); a consensus-agreed expiry
-            # horizon would close it entirely and is future work.  Batch
-            # passes (and the device pipeline) never freeze mid-pass, so
-            # the bit-parity contract is unaffected.
-            self.is_witness[eid] = True
-            self.ancient.append(eid)
-            return
+            self.late_witnesses.append(eid)
+            if self.metrics is not None:
+                self.metrics.count("consensus_late_witnesses")
         self.is_witness[eid] = True
         slots = self.wit_list.setdefault(r, [])
         # slot order (insertion order) is load-bearing: decide_fame scans
@@ -900,6 +986,17 @@ class Node:
                             if 3 * max(yes, no) > 2 * self.tot_stake:
                                 self.famous[x] = yes >= no
                                 decided = True
+                                if self.famous[x] and rx <= self._frozen_round:
+                                    # a late witness decided FAMOUS would
+                                    # retroactively change a committed
+                                    # round's UFW set — impossible below
+                                    # 1/3 equivocating stake; surfaced,
+                                    # never silently absorbed
+                                    self.horizon_violations += 1
+                                    if self.metrics is not None:
+                                        self.metrics.count(
+                                            "consensus_horizon_violations"
+                                        )
                                 break
                     self._next_vote_round[x] = ry + 1
                     if decided:

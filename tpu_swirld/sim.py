@@ -436,6 +436,47 @@ def run_with_divergent_forkers(
     )
 
 
+def make_straggler_event(
+    node: Node,
+    pk: bytes,
+    sk: bytes,
+    *,
+    at_round: int,
+    payload: bytes = b"straggler",
+) -> Event:
+    """Forge the event a lagging member's stale tail produces: an event by
+    ``pk`` whose parents sit deep in ``node``'s history, landing as a
+    WITNESS at (roughly) ``at_round`` — typically far below the committed
+    frontier, i.e. the deterministic-expiry-horizon corner.
+
+    Self-parent: ``pk``'s earliest event with round < ``at_round``;
+    other-parent: the earliest event by another member with round exactly
+    ``at_round`` (so the new event's round is ``at_round`` + at most one
+    promotion, and exceeds the self-parent's round — the witness
+    condition).  When ``pk``'s real chain continued past the chosen
+    self-parent this is also a fork pair, exactly as an equivocating or
+    amnesiac member would produce.  Raises ``ValueError`` when the DAG has
+    no suitable parents yet.
+    """
+    sp = None
+    for eid in node.member_events[pk]:
+        if node.round[eid] < at_round:
+            sp = eid
+            break
+    if sp is None:
+        raise ValueError(f"{pk[:4].hex()} has no event below round {at_round}")
+    op = None
+    for eid in node.order_added:
+        ev = node.hg[eid]
+        if ev.c != pk and node.round[eid] == at_round:
+            op = eid
+            break
+    if op is None:
+        raise ValueError(f"no other-member event at round {at_round}")
+    t = max(node.hg[sp].t, node.hg[op].t) + 1
+    return Event(d=payload, p=(sp, op), t=t, c=pk).signed(sk)
+
+
 def chunked_ingest_schedule(
     events,
     chunk_size: int,

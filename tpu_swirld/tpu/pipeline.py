@@ -36,6 +36,20 @@ fuses them into one jit for the end-to-end device step):
    producing each UFW's earliest-seeing timestamp; consensus timestamp is
    the lower median.
 
+Expiry horizon: the batch pipeline needs no special handling for
+"ancient" straggler witnesses — the deterministic rule (expired iff below
+the fame-complete frontier of the event's OWN ancestry, which provably
+never fires; see :mod:`tpu_swirld.oracle.node`) means every witness simply
+registers in scan order, exactly as the oracle registers it in arrival
+order.  That shared rule is what makes live-oracle state and batch replays
+bit-identical for EVERY history, stragglers included.
+
+Self-healing: the rounds scan reports witness-table overflow as an
+``OVF_ROUND | OVF_SLOT`` bitmask and the host orchestrators retry with the
+flagged capacity grown (``_healed_capacities``) — a fork storm or a deeper
+DAG than the chain-derived ``r_max`` clamp degrades to a slower pass,
+never a ``RuntimeError``.
+
 All supermajorities are exact integer tests ``3*amount > 2*total``.  The
 device stays int32-pure: int64 timestamps are dense-ranked on the host
 (equal timestamps -> equal ranks, so lower-median selection is exact) and
@@ -66,6 +80,13 @@ from tpu_swirld.oracle.node import xor_bytes
 from tpu_swirld.packing import PackedDAG, Packer
 
 INT32_MAX = np.iinfo(np.int32).max
+
+# Witness-table overflow bitmask (the rounds scan's self-diagnosis, so the
+# host can heal the RIGHT capacity instead of fail-stopping): a witness
+# landed outside the retained round window (OVF_ROUND) / a round's witness
+# slots were exhausted (OVF_SLOT).
+OVF_ROUND = 1
+OVF_SLOT = 2
 
 
 def _maybe_span(o, name: str, **args):
@@ -226,10 +247,12 @@ def rounds_scan(
     """Round assignment + witness registration (topo-order scan).
 
     Returns (round int32[N], is_witness bool[N], wit_table int32[r_max,
-    s_max], wit_count int32[r_max], overflow bool[]).  Slot order within a
-    round is registration (= topo) order, as in the oracle.  (The
-    column-restricted variant runs via ``rounds_chunk_stage`` /
-    ``_make_rounds_step`` with a ``col_pos`` map.)
+    s_max], wit_count int32[r_max], overflow int32[] — an OVF_ROUND /
+    OVF_SLOT bitmask so the orchestrator can retry with the right
+    capacity).  Slot order within a round is registration (= topo) order,
+    as in the oracle.  (The column-restricted variant runs via
+    ``rounds_chunk_stage`` / ``_make_rounds_step`` with a ``col_pos``
+    map.)
     """
     step = _make_rounds_step(
         parents, ssm, creator, stake, tot_stake, n_valid,
@@ -242,7 +265,7 @@ def rounds_scan(
         jnp.zeros((n,), dtype=bool),
         jnp.full((r_max, s_max), -1, dtype=jnp.int32),
         jnp.zeros((r_max,), dtype=jnp.int32),
-        jnp.zeros((), dtype=bool),
+        jnp.zeros((), dtype=jnp.int32),
     )
     (rnd, wits, tab, cnt, overflow), _ = lax.scan(
         step, carry0, jnp.arange(n)
@@ -258,9 +281,11 @@ def _make_rounds_step(parents, ssm, creator, stake, tot_stake, n_valid,
     ``rnd`` holds *global* round values; the witness table holds only the
     retained round window — row ``k`` is global round ``r_base + k``
     (``r_base`` a traced scalar so window shifts never retrace).  The
-    batch path passes ``r_base = 0``.  An event landing below the window
-    (round < r_base — a straggler in the incremental path) trips the
-    overflow flag; the incremental driver turns that into a full rebase.
+    batch path passes ``r_base = 0``.  ``overflow`` is an int32 OVF_ROUND
+    / OVF_SLOT bitmask: an event landing outside the window (including a
+    straggler below ``r_base`` in the incremental path) sets OVF_ROUND, a
+    full slot row sets OVF_SLOT; the batch orchestrators self-heal by
+    growing the flagged capacity, the incremental driver rebases.
     """
     n = parents.shape[0]
     n_members = stake.shape[0]
@@ -299,10 +324,12 @@ def _make_rounds_step(parents, ssm, creator, stake, tot_stake, n_valid,
         r = jnp.where(genesis, 0, r0 + promoted)
         rw = r - r_base
         is_wit = (genesis | (r > rnd[p1c])) & (i < n_valid)
-        overflow = overflow | (is_wit & ((rw >= r_max) | (rw < 0)))
+        overflow = overflow | jnp.where(
+            is_wit & ((rw >= r_max) | (rw < 0)), OVF_ROUND, 0
+        )
         rc = jnp.clip(rw, 0, r_max - 1)
         slot = cnt[rc]
-        overflow = overflow | (is_wit & (slot >= s_max))
+        overflow = overflow | jnp.where(is_wit & (slot >= s_max), OVF_SLOT, 0)
         do = is_wit & (slot < s_max) & (rw < r_max) & (rw >= 0)
         slotc = jnp.clip(slot, 0, s_max - 1)
         tab = tab.at[rc, slotc].set(jnp.where(do, i, tab[rc, slotc]))
@@ -900,6 +927,48 @@ def prepare_inputs(
     return arrays, statics, ts_unique
 
 
+def _healed_capacities(ovf: int, *, r_eff: int, r_cap: int, s_eff: int,
+                       s_cap: int) -> Tuple[int, int]:
+    """Translate a rounds-scan overflow bitmask into grown capacities.
+
+    The self-healing contract (no fail-stop on recoverable capacity
+    misses): OVF_ROUND unclamps the witness-table round window straight to
+    ``r_cap`` (``config.max_rounds`` — the chain-derived clamp is a
+    heuristic, not a theorem the pipeline should die on), OVF_SLOT doubles
+    the per-round slot capacity (power-of-two growth keeps the static
+    shapes on the existing bucket discipline).  Raises the *corrected*
+    error — naming the capacity that is genuinely exhausted and the knob
+    that raises it — only when the flagged capacity is already at its hard
+    bound.
+    """
+    r_new, s_new = r_eff, s_eff
+    if ovf & OVF_ROUND:
+        if r_eff >= r_cap:
+            raise RuntimeError(
+                f"consensus rounds exceed the round-window capacity "
+                f"{r_cap} (the larger of config.max_rounds and any "
+                f"explicit r_max); raise SwirldConfig.max_rounds beyond "
+                f"{r_cap}"
+            )
+        r_new = r_cap
+    if ovf & OVF_SLOT:
+        if s_eff >= s_cap:
+            raise RuntimeError(
+                f"witness slots per round exceed the padded event count "
+                f"({s_cap}) — impossible for a valid DAG; this indicates "
+                "packing corruption"
+            )
+        s_new = min(max(2 * s_eff, 1), s_cap)
+    if (r_new, s_new) == (r_eff, s_eff):
+        raise RuntimeError(f"unhealable overflow mask {ovf}")
+    o = obs.current()
+    if o is not None:
+        o.registry.counter("pipeline_overflow_retries_total").inc()
+        o.registry.gauge("pipeline_r_max").set(r_new)
+        o.registry.gauge("pipeline_s_max").set(s_new)
+    return r_new, s_new
+
+
 def run_consensus(
     packed: PackedDAG,
     config: Optional[SwirldConfig] = None,
@@ -965,59 +1034,70 @@ def run_consensus(
         kernel = consensus_fn_for_mesh(mesh)
         if o is not None:
             o.registry.gauge("mesh_devices").set(int(mesh.devices.size))
-        # max_round never exceeds the longest self-chain; bound the fused
-        # kernel's witness table accordingly (same bound as the staged path)
-        r_max = min(r_max, _bucket(chain + 1, 32))
+        # the longest self-chain bounds max_round for honest-shaped DAGs;
+        # use it as the witness-table clamp, backed by the self-healing
+        # retry (an under-provisioned table grows, never crashes)
+        r_eff = min(r_max, _bucket(chain + 1, 32))
+        r_cap = max(int(config.max_rounds), r_max)
         if o is not None:
-            o.registry.gauge("pipeline_r_max").set(r_max)
-        out = obs.stage_call(
-            "pipeline.mesh_consensus",
-            kernel,
-            jnp.asarray(parents),
-            jnp.asarray(creator),
-            jnp.asarray(t_rank),
-            jnp.asarray(coin),
-            jnp.asarray(stake),
-            jnp.asarray(packed.fork_pairs),
-            jnp.asarray(member_table),
-            jnp.asarray(n, dtype=jnp.int32),
-            tot_stake=tot,
-            coin_period=config.coin_period,
-            block=block,
-            r_max=r_max,
-            s_max=s_max,
-            chain=chain,
-            has_forks=bool(len(packed.fork_pairs)),
-            matmul_dtype_name=matmul_dtype_name,
-        )
+            o.registry.gauge("pipeline_r_max").set(r_eff)
         t_dev0 = time.perf_counter()
-        out = jax.tree.map(np.asarray, out)   # blocks on device completion
-        t_device = time.perf_counter() - t_dev0
-        if bool(out["overflow"]):
-            raise RuntimeError(
-                "witness table overflow: raise config.max_rounds / s_max"
+        retries = 0
+        while True:
+            out = obs.stage_call(
+                "pipeline.mesh_consensus",
+                kernel,
+                jnp.asarray(parents),
+                jnp.asarray(creator),
+                jnp.asarray(t_rank),
+                jnp.asarray(coin),
+                jnp.asarray(stake),
+                jnp.asarray(packed.fork_pairs),
+                jnp.asarray(member_table),
+                jnp.asarray(n, dtype=jnp.int32),
+                tot_stake=tot,
+                coin_period=config.coin_period,
+                block=block,
+                r_max=r_eff,
+                s_max=s_max,
+                chain=chain,
+                has_forks=bool(len(packed.fork_pairs)),
+                matmul_dtype_name=matmul_dtype_name,
             )
+            out = jax.tree.map(np.asarray, out)  # blocks on device completion
+            ovf = int(out["overflow"])
+            if not ovf:
+                break
+            r_eff, s_max = _healed_capacities(
+                ovf, r_eff=r_eff, r_cap=r_cap, s_eff=s_max,
+                s_cap=parents.shape[0],
+            )
+            retries += 1
+        t_device = time.perf_counter() - t_dev0
         t_fin0 = time.perf_counter()
         with _maybe_span(o, "pipeline.finalize"):
             result = finalize_order(packed, out, ts_unique)
         result.timings = {
             "device_and_dispatch": round(t_device, 6),
             "finalize_host": round(time.perf_counter() - t_fin0, 6),
+            "overflow_retries": retries,
         }
         return result
 
-    # single-host path: two stages with a tight fame/order r_max.
-    # max_round never exceeds the longest self-chain (a member's round
-    # rises at most once per own event), so the witness table is bounded
-    # by chain+1 rounds; bucket to limit recompiles.
+    # single-host path: two stages with a tight fame/order r_max.  The
+    # longest self-chain bounds max_round for honest-shaped DAGs (a
+    # member's round rises at most once per own event); the clamp is a
+    # recompile-hygiene heuristic backed by the self-healing retry, so an
+    # under-provisioned r_max or s_max grows instead of fail-stopping.
     r_rounds = min(r_max, _bucket(chain + 1, 32))
+    r_cap = max(int(config.max_rounds), r_max)
     if o is not None:
         o.registry.gauge("pipeline_r_max").set(r_rounds)
     if ssm_mode == "columns" and not use_pallas_ssm:
         return _run_consensus_columns(
             packed, config, parents, creator, t_rank, coin, stake,
             member_table, ts_unique, n=n, tot=tot, block=block,
-            r_rounds=r_rounds, s_max=s_max, chain=chain,
+            r_rounds=r_rounds, r_cap=r_cap, s_max=s_max, chain=chain,
             matmul_dtype_name=matmul_dtype_name,
         )
     stage_a_fn = rounds_stage
@@ -1026,26 +1106,32 @@ def run_consensus(
             interpret=jax.default_backend() != "tpu"
         )
     t_dev0 = time.perf_counter()
-    stage_a = obs.stage_call(
-        "pipeline.rounds_stage",
-        stage_a_fn,
-        jnp.asarray(parents),
-        jnp.asarray(creator),
-        jnp.asarray(stake),
-        jnp.asarray(packed.fork_pairs),
-        jnp.asarray(member_table),
-        jnp.asarray(n, dtype=jnp.int32),
-        tot_stake=tot,
-        block=block,
-        r_max=r_rounds,
-        s_max=s_max,
-        has_forks=bool(len(packed.fork_pairs)),
-        matmul_dtype_name=matmul_dtype_name,
-    )
-    if bool(stage_a["overflow"]):
-        raise RuntimeError(
-            "witness table overflow: raise config.max_rounds / s_max"
+    retries = 0
+    while True:
+        stage_a = obs.stage_call(
+            "pipeline.rounds_stage",
+            stage_a_fn,
+            jnp.asarray(parents),
+            jnp.asarray(creator),
+            jnp.asarray(stake),
+            jnp.asarray(packed.fork_pairs),
+            jnp.asarray(member_table),
+            jnp.asarray(n, dtype=jnp.int32),
+            tot_stake=tot,
+            block=block,
+            r_max=r_rounds,
+            s_max=s_max,
+            has_forks=bool(len(packed.fork_pairs)),
+            matmul_dtype_name=matmul_dtype_name,
         )
+        ovf = int(np.asarray(stage_a["overflow"]))
+        if not ovf:
+            break
+        r_rounds, s_max = _healed_capacities(
+            ovf, r_eff=r_rounds, r_cap=r_cap, s_eff=s_max,
+            s_cap=parents.shape[0],
+        )
+        retries += 1
     max_round = int(stage_a["max_round"])     # device -> host scalar
     r_tight = min(r_rounds, _bucket(max_round + 3, 8))
     stage_b = obs.stage_call(
@@ -1087,13 +1173,15 @@ def run_consensus(
     result.timings = {
         "device_and_dispatch": round(t_device, 6),
         "finalize_host": round(time.perf_counter() - t_fin0, 6),
+        "overflow_retries": retries,
     }
     return result
 
 
 def _run_consensus_columns(
     packed, config, parents, creator, t_rank, coin, stake, member_table,
-    ts_unique, *, n, tot, block, r_rounds, s_max, chain, matmul_dtype_name,
+    ts_unique, *, n, tot, block, r_rounds, r_cap, s_max, chain,
+    matmul_dtype_name,
 ):
     """Column-restricted strongly-sees execution (the default path) —
     :func:`_columns_pass` plus host order extraction and timings."""
@@ -1101,8 +1189,8 @@ def _run_consensus_columns(
     t_dev0 = time.perf_counter()
     out, aux = _columns_pass(
         packed, config, parents, creator, t_rank, coin, stake, member_table,
-        n=n, tot=tot, block=block, r_rounds=r_rounds, s_max=s_max,
-        chain=chain, matmul_dtype_name=matmul_dtype_name,
+        n=n, tot=tot, block=block, r_rounds=r_rounds, r_cap=r_cap,
+        s_max=s_max, chain=chain, matmul_dtype_name=matmul_dtype_name,
     )
     t_device = time.perf_counter() - t_dev0
     t_fin0 = time.perf_counter()
@@ -1116,6 +1204,7 @@ def _run_consensus_columns(
         "finalize_host": round(time.perf_counter() - t_fin0, 6),
         "ssm_columns": aux["n_cols"],
         "ssm_col_iterations": aux["n_scans"],
+        "overflow_retries": aux["overflow_retries"],
     }
     return result
 
@@ -1123,7 +1212,7 @@ def _run_consensus_columns(
 def _columns_pass(
     packed, config, parents, creator, t_rank, coin, stake, member_table,
     *, n, tot, block, r_rounds, s_max, chain, matmul_dtype_name,
-    ssm_cols_fn=None,
+    r_cap=None, ssm_cols_fn=None,
 ):
     """Column-restricted strongly-sees execution core.
 
@@ -1204,70 +1293,83 @@ def _columns_pass(
     # queried that witness's round, compute the column and re-run just
     # that chunk (columns are round-independent, so the re-run is exact);
     # otherwise the chunk's outputs are already exact and the new columns
-    # only serve future chunks.
+    # only serve future chunks.  Witness-table overflow self-heals: the
+    # scan restarts with the flagged capacity grown (the column store
+    # survives retries — columns never depend on the table shape), so an
+    # under-provisioned r_max/s_max degrades to a slower pass, never a
+    # crash.
     chunk_size = min(128, n_pad)
     while n_pad % chunk_size:
         chunk_size //= 2
-    state = (
-        jnp.zeros((n_pad,), dtype=jnp.int32),
-        jnp.zeros((n_pad,), dtype=bool),
-        jnp.full((r_rounds, s_max), -1, dtype=jnp.int32),
-        jnp.zeros((r_rounds,), dtype=jnp.int32),
-        jnp.zeros((), dtype=bool),
-    )
     parents_np = parents
-    for start in range(0, n_pad, chunk_size):
-        start_d = jnp.asarray(start, dtype=jnp.int32)
-        # each failed attempt adds at least one column, and a chunk can
-        # register at most chunk_size witnesses, so this bound is safe
-        # even for degenerate one-round-per-event DAGs (2-member gossip)
-        for _attempt in range(chunk_size + 1):
-            out = obs.stage_call(
-                "pipeline.rounds_chunk_stage",
-                rounds_chunk_stage,
-                parents_d, ssm_c, jnp.asarray(col_pos), creator_d,
-                stake_d, n_d, *state, start_d,
-                jnp.zeros((), dtype=jnp.int32),
-                tot_stake=tot, r_max=r_rounds, s_max=s_max,
-                has_forks=has_forks, chunk=chunk_size,
-            )
-            n_scans += 1
-            tab = np.asarray(out[2])
-            registered = np.unique(tab[tab >= 0])
-            missing = registered[col_pos[registered] < 0]
-            if missing.size == 0:
-                state = out
-                break
-            rnd_np = np.asarray(out[0])
-            # was any missing witness's round queried later in this chunk?
-            ce = np.arange(start, start + chunk_size)
-            p = parents_np[ce]
-            r0 = np.where(
-                p[:, 0] < 0,
-                -1,
-                np.maximum(rnd_np[np.maximum(p[:, 0], 0)],
-                           rnd_np[np.maximum(p[:, 1], 0)]),
-            )
-            affected = False
-            for w in missing:
-                if w < start:       # registered in an earlier chunk state?
-                    affected = True  # (shouldn't happen; be safe)
-                    break
-                later = ce > w
-                if np.any(later & (r0 == rnd_np[w])):
-                    affected = True
-                    break
-            add_columns([int(e) for e in missing])
-            if not affected:
-                state = out
-                break
-        else:
-            raise RuntimeError("witness-column chunk did not converge")
-    rnd_a, wits_a, tab_a, cnt_a, overflow_a = state
-    if bool(overflow_a):
-        raise RuntimeError(
-            "witness table overflow: raise config.max_rounds / s_max"
+    if r_cap is None:
+        r_cap = max(int(config.max_rounds), r_rounds)
+    overflow_retries = 0
+    while True:
+        state = (
+            jnp.zeros((n_pad,), dtype=jnp.int32),
+            jnp.zeros((n_pad,), dtype=bool),
+            jnp.full((r_rounds, s_max), -1, dtype=jnp.int32),
+            jnp.zeros((r_rounds,), dtype=jnp.int32),
+            jnp.zeros((), dtype=jnp.int32),
         )
+        for start in range(0, n_pad, chunk_size):
+            start_d = jnp.asarray(start, dtype=jnp.int32)
+            # each failed attempt adds at least one column, and a chunk can
+            # register at most chunk_size witnesses, so this bound is safe
+            # even for degenerate one-round-per-event DAGs (2-member gossip)
+            for _attempt in range(chunk_size + 1):
+                out = obs.stage_call(
+                    "pipeline.rounds_chunk_stage",
+                    rounds_chunk_stage,
+                    parents_d, ssm_c, jnp.asarray(col_pos), creator_d,
+                    stake_d, n_d, *state, start_d,
+                    jnp.zeros((), dtype=jnp.int32),
+                    tot_stake=tot, r_max=r_rounds, s_max=s_max,
+                    has_forks=has_forks, chunk=chunk_size,
+                )
+                n_scans += 1
+                tab = np.asarray(out[2])
+                registered = np.unique(tab[tab >= 0])
+                missing = registered[col_pos[registered] < 0]
+                if missing.size == 0:
+                    state = out
+                    break
+                rnd_np = np.asarray(out[0])
+                # was a missing witness's round queried later in this chunk?
+                ce = np.arange(start, start + chunk_size)
+                p = parents_np[ce]
+                r0 = np.where(
+                    p[:, 0] < 0,
+                    -1,
+                    np.maximum(rnd_np[np.maximum(p[:, 0], 0)],
+                               rnd_np[np.maximum(p[:, 1], 0)]),
+                )
+                affected = False
+                for w in missing:
+                    if w < start:   # registered in an earlier chunk state?
+                        affected = True  # (shouldn't happen; be safe)
+                        break
+                    later = ce > w
+                    if np.any(later & (r0 == rnd_np[w])):
+                        affected = True
+                        break
+                add_columns([int(e) for e in missing])
+                if not affected:
+                    state = out
+                    break
+            else:
+                raise RuntimeError("witness-column chunk did not converge")
+            if int(np.asarray(state[4])):
+                break               # overflow: stop scanning, grow, retry
+        ovf = int(np.asarray(state[4]))
+        if not ovf:
+            break
+        r_rounds, s_max = _healed_capacities(
+            ovf, r_eff=r_rounds, r_cap=r_cap, s_eff=s_max, s_cap=n_pad,
+        )
+        overflow_retries += 1
+    rnd_a, wits_a, tab_a, cnt_a, _overflow_a = state
     max_round_d = jnp.max(jnp.where(jnp.arange(n_pad) < n_d, rnd_a, 0))
     max_round = int(max_round_d)
     r_tight = min(r_rounds, _bucket(max_round + 3, 8))
@@ -1294,7 +1396,8 @@ def _columns_pass(
     aux = {
         "anc": anc, "sees": sees, "ssm_c": ssm_c, "a3": a3, "b3": b3,
         "col_pos": col_pos, "n_cols": n_cols, "w_cap": w_cap,
-        "n_scans": n_scans, "r_rounds": r_rounds,
+        "n_scans": n_scans, "r_rounds": r_rounds, "s_max": s_max,
+        "overflow_retries": overflow_retries,
     }
     return out, aux
 
@@ -1689,6 +1792,7 @@ class IncrementalConsensus:
         self.passes = 0
         self.rebases = 0
         self.recompiles_hint = 0
+        self.overflow_heals = 0   # capacity growths absorbed by rebases
 
         # rebase-storm guard: adversarial ingest (straggler floods, deep
         # orphan replays) can make EVERY pass detect-then-rebase, paying
@@ -2087,7 +2191,7 @@ class IncrementalConsensus:
             jnp.asarray(self._wits_w),
             jnp.asarray(self._tab_np),
             jnp.asarray(self._cnt_np),
-            jnp.zeros((), dtype=bool),
+            jnp.zeros((), dtype=jnp.int32),
         )
         r_base_d = np.int32(self._r_base)
         for start in range(w0, w0 + n_pad_new, chunk):
@@ -2137,8 +2241,11 @@ class IncrementalConsensus:
         wits_w = np.array(state[1])
         tab_np = np.array(state[2])
         cnt_np = np.array(state[3])
-        if bool(np.asarray(state[4])):
-            return [], True          # round/slot capacity overflow -> rebase
+        if int(np.asarray(state[4])):
+            # round/slot capacity overflow -> rebase, which self-heals:
+            # _columns_pass grows the flagged capacity and the adopted
+            # window table inherits it (never a crash)
+            return [], True
         # straggler guard: a witness below the frozen vote horizon could
         # change a committed tally — recompute from scratch instead
         wit_mask = wits_w[sl]
@@ -2367,6 +2474,11 @@ class IncrementalConsensus:
             s_max=self._s_cap, chain=chain, matmul_dtype_name=self._mm,
             ssm_cols_fn=self._ssm_cols_fn,
         )
+        # adopt any self-healed capacities (overflow retries inside the
+        # batch pass grow s_max/r_rounds; the carried window table must
+        # match the batch table's slot shape)
+        self._s_cap = max(self._s_cap, aux["s_max"])
+        self.overflow_heals += aux["overflow_retries"]
         result = finalize_order(packed, out, ts_unique)
 
         # ---- commit everything the batch pass decided
