@@ -5,9 +5,14 @@ Driver contract: print ONE JSON line on stdout:
 value       = device-pipeline consensus throughput (events/sec)
 vs_baseline = speedup over the pure-Python oracle on the same machine
               (BASELINE.json north star: >= 50x on 64 members / 10k events).
-phases      = per-phase wall-clock seconds (tpu_swirld.obs spans):
-              gossip_gen / oracle / pack / pipeline_first (incl. compile) /
-              pipeline (steady), so the headline has per-stage attribution.
+phases      = per-phase wall-clock seconds (tpu_swirld.obs spans) PLUS
+              per-phase peak-memory high-water marks
+              (``mem_<phase>_host_peak_bytes`` via tracemalloc,
+              ``mem_<phase>_device_peak_bytes`` via jax.live_arrays()
+              sizes), so the headline has per-stage time AND memory
+              attribution.  Top-level ``peak_host_bytes`` /
+              ``peak_device_bytes`` carry the run-wide maxima for
+              scripts/bench_compare.py regression gating.
 
 An *incremental steady-state* section (tpu_swirld.tpu.pipeline.
 IncrementalConsensus) additionally ingests the same events in chunks,
@@ -15,11 +20,28 @@ reports ev/s per pass and the first(cold)-vs-steady ratio, and publishes
 window_size / pruned_prefix in the phases breakdown plus a structured
 "incremental" object in the JSON line.
 
+``--stream`` instead runs the BASELINE config-5 shape (256 members /
+100k events; override with BENCH_STREAM_*) through the slab-store
+streaming driver (tpu_swirld.store.StreamingConsensus) under a stated
+resident tile budget (``--tile-budget``): events are generated as a
+stream (host memory O(chunk)), decided rows retire to the host archive,
+and the decided-prefix order is parity-checked against a pure-Python
+oracle over a subsampled prefix.  The JSON line then reports streaming
+ev/s, the tile budget, peak resident visibility bytes, and archive
+stats — the config-5 acceptance artifact.
+
 All detail goes to stderr.  Environment knobs:
     BENCH_MEMBERS (64)  BENCH_EVENTS (10000)  BENCH_ORACLE_EVENTS (10000)
     BENCH_TPU_PROBE_TIMEOUT (240 s)  BENCH_FORCE_CPU (unset)
+    BENCH_TPU_PROBE_CACHE (.tpu_probe.json)  BENCH_TPU_PROBE_TTL (3600 s)
+      — the probe outcome is cached with a TTL so repeated CPU-fallback
+      runs skip the 240 s axon-tunnel hang (BENCH_r05.json documents it);
+      delete the cache file or set TTL 0 to force a fresh probe.
+    BENCH_MEM (1) — 0 disables the tracemalloc/live-array memory monitor.
     BENCH_INC_CHUNK (1000) — incremental ingest chunk; 0 disables the
     incremental section.
+    BENCH_STREAM_MEMBERS (256)  BENCH_STREAM_EVENTS (100000)
+    BENCH_STREAM_CHUNK (2048)  BENCH_STREAM_ORACLE (4000)
     BENCH_TRACE (unset) — write the full span trace + gauge snapshot to
     this path (JSONL; render with `python -m tpu_swirld.obs report`).
 
@@ -29,6 +51,7 @@ probe it in a SUBPROCESS with a hard timeout and fall back to CPU (with the
 platform recorded in stderr) rather than hanging the driver.
 """
 
+import argparse
 import json
 import os
 import subprocess
@@ -39,7 +62,19 @@ MEMBERS = int(os.environ.get("BENCH_MEMBERS", "64"))
 EVENTS = int(os.environ.get("BENCH_EVENTS", "10000"))
 ORACLE_EVENTS = int(os.environ.get("BENCH_ORACLE_EVENTS", "10000"))
 PROBE_TIMEOUT = float(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "240"))
+PROBE_CACHE = os.environ.get("BENCH_TPU_PROBE_CACHE", ".tpu_probe.json")
+PROBE_TTL = float(os.environ.get("BENCH_TPU_PROBE_TTL", "3600"))
 INC_CHUNK = int(os.environ.get("BENCH_INC_CHUNK", "1000"))
+MEM = os.environ.get("BENCH_MEM", "1") != "0"
+
+STREAM_MEMBERS = int(os.environ.get("BENCH_STREAM_MEMBERS", "256"))
+STREAM_EVENTS = int(os.environ.get("BENCH_STREAM_EVENTS", "100000"))
+STREAM_CHUNK = int(os.environ.get("BENCH_STREAM_CHUNK", "2048"))
+# 256-member rounds fame-complete every ~4k events and ordering starts
+# around 10-12k, so the oracle prefix must reach that deep for the
+# decided-prefix order parity to be non-vacuous (the JSON reports
+# oracle_decided so a too-shallow override is visible)
+STREAM_ORACLE = int(os.environ.get("BENCH_STREAM_ORACLE", "12000"))
 
 
 def log(*a):
@@ -48,15 +83,28 @@ def log(*a):
 
 def probe_tpu() -> bool:
     """Can the default (axon/TPU) backend initialize? Probe in a child
-    process under a hard timeout so a wedged PJRT init can't hang us."""
+    process under a hard timeout so a wedged PJRT init can't hang us.
+    The outcome is cached to ``BENCH_TPU_PROBE_CACHE`` with a TTL so
+    back-to-back CPU-fallback runs don't each pay the full hang."""
     if os.environ.get("BENCH_FORCE_CPU"):
         return False
+    try:
+        with open(PROBE_CACHE) as f:
+            c = json.load(f)
+        age = time.time() - float(c["time"])
+        if 0 <= age <= PROBE_TTL:
+            log(f"[probe] cached ({PROBE_CACHE}, age {age:.0f}s <= ttl "
+                f"{PROBE_TTL:.0f}s): ok={c['ok']}")
+            return bool(c["ok"])
+    except (OSError, ValueError, KeyError, TypeError):
+        pass
     code = (
         "import jax; d = jax.devices(); "
         "import jax.numpy as jnp; "
         "x = jax.jit(lambda a: a @ a)(jnp.ones((128, 128), jnp.bfloat16)); "
         "x.block_until_ready(); print(d[0].platform)"
     )
+    ok = False
     try:
         t0 = time.time()
         r = subprocess.run(
@@ -67,13 +115,28 @@ def probe_tpu() -> bool:
         )
         log(f"[probe] rc={r.returncode} in {time.time()-t0:.0f}s: "
             f"{(r.stdout or r.stderr).strip().splitlines()[-1] if (r.stdout or r.stderr).strip() else ''}")
-        return r.returncode == 0
+        ok = r.returncode == 0
     except subprocess.TimeoutExpired:
         log(f"[probe] TPU backend init exceeded {PROBE_TIMEOUT:.0f}s — falling back to CPU")
-        return False
+    try:
+        with open(PROBE_CACHE, "w") as f:
+            json.dump(
+                {"ok": ok, "time": time.time(),
+                 "timeout_s": PROBE_TIMEOUT}, f,
+            )
+        log(f"[probe] cached outcome -> {PROBE_CACHE} (ttl {PROBE_TTL:.0f}s)")
+    except OSError:
+        pass
+    return ok
 
 
-def main():
+def _mem_monitor():
+    from tpu_swirld.obs import MemoryMonitor
+
+    return MemoryMonitor(enable_host=MEM)
+
+
+def run_default():
     tpu_ok = probe_tpu()
     import jax
 
@@ -95,12 +158,13 @@ def main():
     # registry.  The steady (headline) run is spanned but NOT ambient —
     # per-stage sync would perturb the number being published.
     o = obslib.Obs()
+    mon = _mem_monitor()
 
     n_events = EVENTS if tpu_ok else min(EVENTS, 10000)
     if n_events != EVENTS:
         log(f"[env] CPU fallback: clamping BENCH_EVENTS {EVENTS} -> {n_events}")
     t0 = time.time()
-    with o.tracer.span("gossip_gen"):
+    with o.tracer.span("gossip_gen"), mon.phase("gossip_gen"):
         members, stake, events, keys = generate_gossip_dag(
             MEMBERS, n_events, seed=1
         )
@@ -115,7 +179,7 @@ def main():
     new_ids = [ev.id for ev in events[:n_oracle] if node.add_event(ev)]
     node.metrics = Metrics(registry=o.registry)   # per-phase oracle seconds
     t0 = time.time()
-    with o.tracer.span("oracle"):
+    with o.tracer.span("oracle"), mon.phase("oracle"):
         node.consensus_pass(new_ids)
     t_oracle = time.time() - t0
     oracle_evps = n_oracle / t_oracle
@@ -124,7 +188,7 @@ def main():
 
     # ---- device pipeline (full DAG), parity-checked on the oracle prefix --
     t0 = time.time()
-    with o.tracer.span("pack"):
+    with o.tracer.span("pack"), mon.phase("pack"):
         packed_prefix = pack_events(events[:n_oracle], members, stake)
         packed_full = pack_events(events, members, stake)
     log(f"[pack] {time.time()-t0:.2f}s")
@@ -143,11 +207,12 @@ def main():
 
     t0 = time.time()
     with obslib.enabled(o):           # stage spans + compile attribution
-        with o.tracer.span("pipeline_first"):
+        with o.tracer.span("pipeline_first"), mon.phase("pipeline_first"):
             res = run_consensus(packed_full, node.config)
     t_compile_and_run = time.time() - t0
     t0 = time.time()
-    with o.tracer.span("pipeline"):   # wall-clock only: no per-stage sync
+    with o.tracer.span("pipeline"), mon.phase("pipeline"):
+        # wall-clock only: no per-stage sync
         res = run_consensus(packed_full, node.config)
     t_steady = time.time() - t0
     pipe_evps = n_events / t_steady
@@ -161,12 +226,14 @@ def main():
 
         inc = IncrementalConsensus(members, stake, node.config)
         pass_stats = []
-        with o.tracer.span("pipeline_incremental"):
+        with o.tracer.span("pipeline_incremental"), \
+                mon.phase("pipeline_incremental"):
             for i in range(0, n_events, INC_CHUNK):
                 t0 = time.time()
                 st = inc.ingest(events[i : i + INC_CHUNK])
                 dt = time.time() - t0
                 pass_stats.append((dt, st))
+                mon.sample("pipeline_incremental")
                 log(f"[inc] pass {len(pass_stats)-1}: {st['new_events']} ev "
                     f"in {dt:.3f}s = {st['new_events']/dt:.0f} ev/s "
                     f"window={st['window_size']} pruned={st['pruned_prefix']}"
@@ -212,6 +279,7 @@ def main():
     if inc_out is not None:
         phases["incremental_window_size"] = inc_out["window_size"]
         phases["incremental_pruned_prefix"] = inc_out["pruned_prefix"]
+    phases.update(mon.flat())
     log(f"[phases] {json.dumps(phases)}")
     trace_path = os.environ.get("BENCH_TRACE")
     if trace_path:
@@ -229,12 +297,159 @@ def main():
         "unit": "events/s",
         "vs_baseline": round(speedup, 2),
         "phases": phases,
+        "peak_host_bytes": mon.peak_host_bytes,
+        "peak_device_bytes": mon.peak_device_bytes,
     }
     if inc_out is not None:
         out["incremental"] = inc_out
     print(json.dumps(out), flush=True)
+    mon.close()
     if not parity or (inc_out is not None and not inc_out["parity"]):
         sys.exit(1)
+
+
+def run_stream(tile_budget, tile):
+    """BASELINE config-5 shape under a stated resident tile budget."""
+    tpu_ok = probe_tpu()
+    import jax
+
+    if not tpu_ok:
+        jax.config.update("jax_platforms", "cpu")
+    platform = jax.devices()[0].platform
+    log(f"[env] platform={platform} devices={len(jax.devices())} "
+        f"stream {STREAM_MEMBERS}x{STREAM_EVENTS} chunk={STREAM_CHUNK} "
+        f"tile_budget={tile_budget} tile={tile}")
+
+    from tpu_swirld.config import SwirldConfig
+    from tpu_swirld.oracle.node import Node
+    from tpu_swirld.sim import stream_gossip_dag
+    from tpu_swirld.store import StreamingConsensus
+
+    mon = _mem_monitor()
+    cfg = SwirldConfig(n_members=STREAM_MEMBERS)
+    members, stake, keys, chunks = stream_gossip_dag(
+        STREAM_MEMBERS, STREAM_EVENTS, STREAM_CHUNK, seed=1
+    )
+    # the oracle replays only the subsampled prefix — the streaming
+    # driver's decided prefix must be bit-identical over it
+    n_oracle = min(STREAM_ORACLE, STREAM_EVENTS)
+    oracle = Node(
+        sk=keys[0][1], pk=members[0], network={}, members=members,
+        clock=lambda: 0, create_genesis=False,
+    )
+    oracle_buf = []
+
+    inc = StreamingConsensus(
+        members, stake, cfg,
+        tile_budget=tile_budget, tile=tile,
+        ingest_chunk=STREAM_CHUNK, window_bucket=2048, prune_min=1024,
+    )
+    n_done = 0
+    t_all = time.time()
+    with mon.phase("stream"):
+        for chunk in chunks:
+            if n_done < n_oracle:
+                oracle_buf.extend(chunk[: n_oracle - n_done])
+            t0 = time.time()
+            st = inc.ingest(chunk)
+            dt = time.time() - t0
+            n_done += len(chunk)
+            mon.sample("stream")
+            log(f"[stream] {n_done}/{STREAM_EVENTS}: {len(chunk)} ev in "
+                f"{dt:.2f}s = {len(chunk)/dt:.0f} ev/s "
+                f"window={st['window_size']} pruned={st['pruned_prefix']} "
+                f"resident={st['resident_bytes']/1e6:.0f}MB "
+                f"archived={st['archived_rows']}"
+                f"{' REBASE' if st['rebased'] else ''}")
+    t_stream = time.time() - t_all
+    stream_evps = n_done / t_stream
+    res = inc.result()
+    log(f"[stream] {n_done} ev in {t_stream:.1f}s = {stream_evps:.0f} ev/s; "
+        f"ordered {len(res.order)}, max_round {res.max_round}, "
+        f"pruned {inc.pruned_prefix}, window {inc.window_size}")
+
+    with mon.phase("oracle_subsample"):
+        new_ids = [ev.id for ev in oracle_buf if oracle.add_event(ev)]
+        oracle.consensus_pass(new_ids)
+    got = [inc.packer.event_id(i) for i in res.order[: len(oracle.consensus)]]
+    order_parity = got == oracle.consensus
+    round_parity = all(
+        int(res.round[i]) == oracle.round[eid]
+        for i, eid in enumerate(oracle.order_added)
+    )
+    parity = order_parity and round_parity
+    log(f"[parity] oracle prefix {n_oracle} ev, decided {len(oracle.consensus)}: "
+        f"order={order_parity} rounds={round_parity}")
+
+    stats = inc.store.stats()
+    budget_ok = (
+        tile_budget is None
+        or stats["peak_resident_tiles"] <= tile_budget
+    )
+    log(f"[store] {json.dumps(stats)} budget_ok={budget_ok}")
+    phases = mon.flat()
+    out = {
+        "metric": (
+            f"streaming events/sec to consensus-order "
+            f"@{n_done} events x {STREAM_MEMBERS} members ({platform}, "
+            f"config-5 shape, tile budget {tile_budget}); "
+            f"oracle-prefix parity={parity}"
+        ),
+        "value": round(stream_evps, 1),
+        "unit": "events/s",
+        "vs_baseline": 0.0,
+        "phases": phases,
+        "peak_host_bytes": mon.peak_host_bytes,
+        "peak_device_bytes": mon.peak_device_bytes,
+        "stream": {
+            "members": STREAM_MEMBERS,
+            "events": n_done,
+            "chunk": STREAM_CHUNK,
+            "tile": tile,
+            "tile_budget": tile_budget,
+            "budget_ok": bool(budget_ok),
+            "ordered": len(res.order),
+            "max_round": int(res.max_round),
+            "window_size": inc.window_size,
+            "pruned_prefix": inc.pruned_prefix,
+            "peak_resident_visibility_bytes": stats["peak_resident_bytes"],
+            "peak_resident_tiles": stats["peak_resident_tiles"],
+            "archived_rows": stats["archived_rows"],
+            "archive_bytes": stats["archive_bytes"],
+            "widen_rebases": inc.widen_rebases,
+            "full_rebases": inc.full_rebases,
+            "oracle_prefix": n_oracle,
+            "oracle_decided": len(oracle.consensus),
+            "parity": bool(parity),
+        },
+    }
+    print(json.dumps(out), flush=True)
+    mon.close()
+    if not parity or not budget_ok:
+        sys.exit(1)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--stream", action="store_true",
+        help="run the BASELINE config-5 shape (256 members / 100k events; "
+        "BENCH_STREAM_* overrides) through the slab-store streaming "
+        "driver under --tile-budget instead of the default bench",
+    )
+    ap.add_argument(
+        "--tile-budget", type=int, default=65536,
+        help="resident visibility tile budget for --stream (tiles of "
+        "--tile x --tile bools; default 65536 = 4 GB bool ceiling at "
+        "tile 256 — the config-5 window peaks around ~2.2 GB); "
+        "0 = unbounded (account only)",
+    )
+    ap.add_argument("--tile", type=int, default=256, help="tile side")
+    args = ap.parse_args(argv)
+    if args.stream:
+        run_stream(args.tile_budget or None, args.tile)
+    else:
+        run_default()
 
 
 if __name__ == "__main__":

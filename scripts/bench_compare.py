@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""Diff two BENCH_*.json artifacts and fail on throughput regression.
+"""Diff two BENCH_*.json artifacts and fail on throughput OR memory
+regression.
 
 Usage:
     python scripts/bench_compare.py OLD.json NEW.json [--threshold 0.10]
@@ -7,10 +8,17 @@ Usage:
 
 Compares ``NEW[key]`` against ``OLD[key]`` (default key: ``value``, the
 headline events/sec) and exits nonzero when the new number is more than
-``threshold`` (default 10%) below the old one.  The incremental
-steady-state throughput (``incremental.steady_evps``) is compared too
-when both files carry it.  Everything else (phases, window stats) is
-printed as an informational diff.
+``threshold`` (default 10%) below the old one.  Also compared, when both
+files carry them:
+
+- ``incremental.steady_evps`` (higher is better — drop >threshold fails);
+- the peak-memory metrics ``peak_host_bytes`` / ``peak_device_bytes`` /
+  ``stream.peak_resident_visibility_bytes`` (LOWER is better — a rise
+  >threshold fails, so a change that silently re-materializes an
+  O(N²) slab trips the gate even when throughput improves).
+
+Everything else (phases, window stats) is printed as an informational
+diff.
 
 Opt-in wiring: this is NOT part of tier-1 (bench numbers are machine-
 dependent); run it from CI or by hand after a bench run, e.g.::
@@ -29,6 +37,15 @@ import json
 import sys
 from typing import Any, Dict, Optional
 
+#: (dotted key, higher_is_better) — memory keys gate in the opposite
+#: direction from throughput keys
+EXTRA_KEYS = [
+    ("incremental.steady_evps", True),
+    ("peak_host_bytes", False),
+    ("peak_device_bytes", False),
+    ("stream.peak_resident_visibility_bytes", False),
+]
+
 
 def _get(d: Dict[str, Any], dotted: str) -> Optional[float]:
     cur: Any = d
@@ -46,23 +63,27 @@ def compare(old: Dict, new: Dict, key: str, threshold: float):
     """Returns (failures, report_lines)."""
     lines = []
     failures = []
-    for k in [key, "incremental.steady_evps"]:
+    for k, higher_better in [(key, True)] + EXTRA_KEYS:
         ov, nv = _get(old, k), _get(new, k)
         if ov is None or nv is None:
             if k == key:
                 failures.append(f"missing key {k!r} in one of the inputs")
             continue
         delta = (nv - ov) / ov if ov else 0.0
+        bad = delta < -threshold if higher_better else delta > threshold
         verdict = "ok"
-        if delta < -threshold:
-            verdict = f"REGRESSION (>{threshold:.0%} below old)"
+        if bad:
+            direction = "below" if higher_better else "above"
+            verdict = f"REGRESSION (>{threshold:.0%} {direction} old)"
             failures.append(f"{k}: {ov:.1f} -> {nv:.1f} ({delta:+.1%})")
-        lines.append(f"{k:<28} {ov:>12.1f} -> {nv:>12.1f}  {delta:+7.1%}  {verdict}")
+        lines.append(
+            f"{k:<40} {ov:>14.1f} -> {nv:>14.1f}  {delta:+7.1%}  {verdict}"
+        )
     op, np_ = old.get("phases") or {}, new.get("phases") or {}
     for k in sorted(set(op) | set(np_)):
         ov, nv = op.get(k), np_.get(k)
         if isinstance(ov, (int, float)) and isinstance(nv, (int, float)):
-            lines.append(f"  phase {k:<28} {ov:>10} -> {nv:>10}")
+            lines.append(f"  phase {k:<40} {ov:>12} -> {nv:>12}")
     return failures, lines
 
 
@@ -71,7 +92,8 @@ def main(argv=None) -> int:
     ap.add_argument("old", help="baseline BENCH json file")
     ap.add_argument("new", help="candidate BENCH json file")
     ap.add_argument("--threshold", type=float, default=0.10,
-                    help="allowed fractional drop (default 0.10 = 10%%)")
+                    help="allowed fractional drop in throughput / rise in "
+                         "peak memory (default 0.10 = 10%%)")
     ap.add_argument("--key", default="value",
                     help="headline metric key (default: value)")
     args = ap.parse_args(argv)
@@ -85,7 +107,7 @@ def main(argv=None) -> int:
     if failures:
         print("\nFAIL:", "; ".join(failures), file=sys.stderr)
         return 1
-    print("\nOK: no throughput regression beyond "
+    print("\nOK: no throughput or peak-memory regression beyond "
           f"{args.threshold:.0%}")
     return 0
 

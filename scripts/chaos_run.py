@@ -77,6 +77,18 @@ def main(argv=None) -> int:
         "cross-engine bit-parity verdict; overflow_storm: witness-table "
         "self-healing (fork storm + round clamp) verdict",
     )
+    ap.add_argument(
+        "--engine",
+        choices=("incremental", "streaming"),
+        default="incremental",
+        help="windowed device driver for the cross-engine parity section: "
+        "incremental (IncrementalConsensus, default) or streaming "
+        "(StreamingConsensus over the slab store — decided rows retire to "
+        "the host archive and pruned-history references exercise the "
+        "widening rebase).  The acceptance scenario gains an 'engines' "
+        "verdict section; the storm scenarios replay with the chosen "
+        "driver.",
+    )
     ap.add_argument("--seed", type=int, default=0, help="population seed")
     ap.add_argument("--plan-seed", type=int, default=0, help="fault stream seed")
     ap.add_argument("--nodes", type=int, default=6)
@@ -97,7 +109,8 @@ def main(argv=None) -> int:
         # silently attributing the verdict to knobs that never applied
         print(
             f"note: --scenario {args.scenario} uses its built-in schedule; "
-            "only --seed applies (other knobs ignored)",
+            "only --seed (and, for horizon_storm, --engine) applies "
+            "(other knobs ignored)",
             file=sys.stderr,
         )
     with tempfile.TemporaryDirectory(prefix="chaos-ckpt-") as ckpt_dir:
@@ -106,7 +119,8 @@ def main(argv=None) -> int:
             # counters, and pipeline gauges all land in the same trace
             if args.scenario == "horizon_storm":
                 verdict = run_horizon_storm(
-                    ckpt_dir, seed=args.seed, metrics=Metrics(o.registry)
+                    ckpt_dir, seed=args.seed, metrics=Metrics(o.registry),
+                    engine=args.engine,
                 )
             elif args.scenario == "overflow_storm":
                 verdict = run_overflow_storm(seed=args.seed)
@@ -116,11 +130,25 @@ def main(argv=None) -> int:
                     metrics=Metrics(o.registry),
                 )
                 verdict = sim.run()
+                # cross-engine parity over the chaos-shaped DAG: the most
+                # complete honest node's history replayed through the
+                # chosen windowed driver must match batch and oracle
+                from tpu_swirld.chaos import _engines_agree
+
+                probe = max(sim._live_honest(), key=lambda n: len(n.hg))
+                engines = _engines_agree(probe, engine=args.engine)
+                verdict["engines"] = engines
+                verdict["ok"] = bool(
+                    verdict["ok"]
+                    and engines["batch_oracle_parity"]
+                    and engines["incremental_batch_parity"]
+                )
         trace_path = os.path.splitext(args.out)[0] + ".trace.jsonl"
         o.save(trace_path)
     with open(args.out, "w") as f:
         json.dump(verdict, f, indent=2, sort_keys=True)
-    for key in ("safety", "liveness", "horizon", "fork_storm", "round_clamp"):
+    for key in ("safety", "liveness", "horizon", "fork_storm", "round_clamp",
+                "engines"):
         if key in verdict:
             print(json.dumps({key: verdict[key]}, sort_keys=True))
     print(f"verdict: {'OK' if verdict['ok'] else 'FAIL'} -> {args.out}")

@@ -23,7 +23,13 @@ so SURVEY.md + BASELINE.json pin the spec), redesigned TPU-first:
   (the reference's ``test(n_nodes, n_turns)``), synthetic DAG generation
   at benchmark scale, and two byzantine adversaries (consistent-order
   fork injection + divergent equivocation).
-- ``tpu_swirld.checkpoint`` — packed-DAG and full-node save/restore.
+- ``tpu_swirld.store`` — the tiled slab store: a host-side append-only
+  archive of decided visibility rows, a fixed tile-budget accounting
+  surface (``resident_tiles`` / ``spill`` / ``fetch``), and the
+  ``StreamingConsensus`` driver whose resident device memory is bounded
+  by the undecided window (BASELINE config 5 at full scale).
+- ``tpu_swirld.checkpoint`` — packed-DAG, full-node, and slab-archive
+  save/restore (digest-verified).
 - ``tpu_swirld.metrics`` — per-phase timers, protocol gauges, profiler.
 - ``tpu_swirld.viz`` — per-event state export (both backends), JSON /
   Graphviz / ASCII renderers.

@@ -403,13 +403,26 @@ def run_chaos(
 #   auto-retry instead of fail-stopping, with parity preserved.
 
 
-def _engines_agree(node) -> Dict:
+def _engines_agree(node, engine: str = "incremental") -> Dict:
     """Cross-engine agreement for one node's full DAG: live oracle state
-    vs a cold batch ``run_consensus`` vs an ``IncrementalConsensus`` drive
-    over chunked ingest.  Returns comparison booleans (all pure-function
-    replays of the same DAG, so anything but bit-equality is a bug)."""
+    vs a cold batch ``run_consensus`` vs a windowed driver replaying the
+    same chunked ingest.  ``engine`` picks the windowed driver:
+    ``"incremental"`` (:class:`~tpu_swirld.tpu.pipeline.
+    IncrementalConsensus`) or ``"streaming"`` (:class:`~tpu_swirld.store.
+    streaming.StreamingConsensus` — decided rows retire into the slab
+    archive and pruned-history references take the widening-rebase path,
+    so chaos traffic exercises spill/fetch too).  Returns comparison
+    booleans (all pure-function replays of the same DAG, so anything but
+    bit-equality is a bug)."""
     from tpu_swirld.packing import pack_node
     from tpu_swirld.tpu.pipeline import IncrementalConsensus, run_consensus
+
+    if engine == "streaming":
+        from tpu_swirld.store.streaming import StreamingConsensus as _Driver
+    elif engine == "incremental":
+        _Driver = IncrementalConsensus
+    else:
+        raise ValueError(f"unknown engine {engine!r}")
 
     packed = pack_node(node)
     batch = run_consensus(packed, node.config, block=64)
@@ -429,7 +442,7 @@ def _engines_agree(node) -> Dict:
     )
     events = [node.hg[e] for e in node.order_added]
     stake = [node.stake[m] for m in node.members]
-    inc = IncrementalConsensus(
+    inc = _Driver(
         node.members, stake, node.config, block=64, chunk=64,
         window_bucket=256, prune_min=64,
     )
@@ -444,11 +457,16 @@ def _engines_agree(node) -> Dict:
         and (res.round_received == batch.round_received).all()
         and (res.consensus_ts == batch.consensus_ts).all()
     )
-    return {
+    out = {
+        "engine": engine,
         "batch_oracle_parity": bool(batch_oracle),
         "incremental_batch_parity": bool(inc_batch),
         "incremental_rebases": inc.rebases,
     }
+    if engine == "streaming":
+        out["store"] = inc.store.stats()
+        out["widen_rebases"] = inc.widen_rebases
+    return out
 
 
 def horizon_storm_scenario(seed: int = 1, n_turns: int = 260) -> ChaosScenario:
@@ -469,7 +487,7 @@ def horizon_storm_scenario(seed: int = 1, n_turns: int = 260) -> ChaosScenario:
 
 
 def run_horizon_storm(ckpt_dir: str, seed: int = 1, metrics=None,
-                      tracer=None) -> Dict:
+                      tracer=None, engine: str = "incremental") -> Dict:
     """Run the straggler-witness scenario and extend the verdict with the
     horizon section: late-witness counts and cross-engine agreement.  The
     old node-local quarantine made exactly this history a documented
@@ -515,7 +533,7 @@ def run_horizon_storm(ckpt_dir: str, seed: int = 1, metrics=None,
     late = sum(len(n.late_witnesses) for n in nodes)
     violations = sum(n.horizon_violations for n in nodes)
     probe = max(nodes, key=lambda n: len(n.hg))
-    engines = _engines_agree(probe)
+    engines = _engines_agree(probe, engine=engine)
     verdict["horizon"] = {
         "late_witnesses": late,
         "horizon_violations": violations,

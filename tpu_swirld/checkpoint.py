@@ -102,6 +102,24 @@ def load_packed(path: str) -> PackedDAG:
     )
 
 
+def save_archive(path: str, archive) -> None:
+    """Persist a :class:`~tpu_swirld.store.archive.SlabArchive` (the
+    streaming driver's decided-row store) as one ``.npz``: compressed row
+    blobs, the retired-round ledger, and a BLAKE2b digest of the blob
+    stream.  No pickle."""
+    archive.save(path)
+
+
+def load_archive(path: str):
+    """Restore an archive and **verify its digest** — corruption or
+    tampering raises ``ValueError`` at restore time rather than feeding
+    wrong ancestry into a later widening rebase (the same fail-loudly
+    contract :func:`load_node` applies to the decided prefix)."""
+    from tpu_swirld.store.archive import SlabArchive
+
+    return SlabArchive.load(path)
+
+
 def save_node(path: str, node: Node) -> None:
     """Write the node's full event log (wire format) + config + members."""
     log = b"".join(encode_event(node.hg[e]) for e in node.order_added)

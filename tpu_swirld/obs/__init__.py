@@ -48,6 +48,9 @@ import contextlib
 import time
 from typing import Optional
 
+from tpu_swirld.obs.memory import (  # noqa: F401
+    MemoryMonitor, device_live_bytes,
+)
 from tpu_swirld.obs.registry import (  # noqa: F401
     Counter, Gauge, Histogram, Registry,
 )

@@ -236,6 +236,20 @@ class Packer:
         )
 
 
+def chunk_slices(n: int, chunk: int) -> List[Tuple[int, int]]:
+    """Chunk-aligned ``[start, stop)`` slices covering ``[0, n)``.
+
+    Every piece except the last is exactly ``chunk`` long, so a consumer
+    that pads each piece to a ``chunk`` multiple (the device scan stages)
+    wastes padding on at most one piece per delta.  Any split of a
+    topologically ordered stream is itself topologically valid, so the
+    slices can be ingested independently.
+    """
+    if chunk <= 0:
+        raise ValueError("chunk must be positive")
+    return [(s, min(n, s + chunk)) for s in range(0, n, chunk)]
+
+
 def pack_events(
     events: Sequence[Event],
     members: Sequence[bytes],

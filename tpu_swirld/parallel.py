@@ -172,6 +172,24 @@ def make_ssm_cols_fn_for_mesh(mesh: Mesh):
     return fn
 
 
+def streaming_consensus_for_mesh(
+    mesh: Mesh, members, stake=None, config=None, **kw
+):
+    """A :class:`~tpu_swirld.store.streaming.StreamingConsensus` whose
+    strongly-sees column kernel is sharded over ``mesh`` — tile work
+    (the ``(W, K) @ (K, C)`` member hops over the resident window) runs
+    member-parallel with one ``psum`` stake tally, so the streaming path
+    composes with the mesh exactly like the incremental one."""
+    from tpu_swirld.store.streaming import StreamingConsensus
+
+    kernel = make_ssm_cols_fn_for_mesh(mesh)
+    kw.setdefault(
+        "ssm_cols_fn",
+        functools.partial(obs.stage_call, "pipeline.ssm_cols_mesh", kernel),
+    )
+    return StreamingConsensus(members, stake, config, **kw)
+
+
 _mesh_fns = {}
 
 

@@ -512,6 +512,77 @@ def chunked_ingest_schedule(
     return out
 
 
+def stream_gossip_dag(
+    n_members: int,
+    n_events: int,
+    chunk: int,
+    seed: int = 0,
+    stake: Optional[List[int]] = None,
+    n_forkers: int = 0,
+    fork_prob: float = 0.05,
+):
+    """Streaming variant of :func:`generate_gossip_dag`: returns
+    ``(members, stake, keys, chunks)`` where ``chunks`` is a *generator*
+    of topo-ordered event lists of size ``chunk``.
+
+    Identical event stream to :func:`generate_gossip_dag` for the same
+    arguments (same RNG call pattern), but host memory stays
+    O(members + chunk): only the per-member branch heads are retained, so
+    a config-5-shaped feed (256 members / 100k events) never holds the
+    full history — the shape ``bench.py --stream`` ingests.
+    """
+    rng = random.Random(seed)
+    keys = [crypto.keypair(b"dag-%d-%d" % (seed, i)) for i in range(n_members)]
+    members = [pk for pk, _ in keys]
+    stake = list(stake) if stake is not None else [1] * n_members
+
+    def chunks():
+        branches: List[List[Event]] = []
+        buf: List[Event] = []
+        n_done = 0
+        t = 0
+        for pk, sk in keys:
+            t += 1
+            ev = Event(d=b"", p=(), t=t, c=pk).signed(sk)
+            buf.append(ev)
+            branches.append([ev])
+        n_total = n_done + len(buf)
+        while n_total < n_events:
+            ci = rng.randrange(n_members)
+            pi = rng.randrange(n_members - 1)
+            if pi >= ci:
+                pi += 1
+            pk, sk = keys[ci]
+            other = branches[pi][rng.randrange(len(branches[pi]))]
+            bi = rng.randrange(len(branches[ci]))
+            head = branches[ci][bi]
+            t += 1
+            fork_now = (
+                ci < n_forkers and head.p and rng.random() < fork_prob
+            )
+            if fork_now:
+                sp = head.p[0]
+                ev = Event(
+                    d=b"fork:%d" % n_total, p=(sp, other.id), t=t, c=pk
+                ).signed(sk)
+                branches[ci].append(ev)
+            else:
+                ev = Event(
+                    d=b"tx:%d" % n_total, p=(head.id, other.id), t=t, c=pk
+                ).signed(sk)
+                branches[ci][bi] = ev
+            buf.append(ev)
+            n_total += 1
+            if len(buf) >= chunk:
+                yield buf
+                n_done += len(buf)
+                buf = []
+        if buf:
+            yield buf
+
+    return members, stake, keys, chunks()
+
+
 def generate_gossip_dag(
     n_members: int,
     n_events: int,

@@ -1,0 +1,317 @@
+"""Append-only host-side column archive of decided ancestry rows.
+
+The streaming driver retires (spills) every event below the decided
+frontier here; the device keeps only the undecided window resident.  Each
+archived row ``e`` is the event's **full global ancestry bitmap** over
+columns ``[0, e]`` (reflexive, topo order ⇒ nothing newer is an ancestor),
+stored as a zlib-compressed ``np.packbits`` blob — gossip-DAG ancestry rows
+are almost-all-ones below a recent horizon, so they compress to a few
+percent of the raw ``N²/8`` bytes.
+
+Rows arrive in two shapes:
+
+- :meth:`spill` — *window rows* from the live driver, covering only the
+  retained columns ``[lo, hi)``.  The prefix ``[0, lo)`` was pruned from
+  the device slab earlier; it is reconstructed exactly from the parents'
+  archived rows (``anc(e) ∩ [0, lo) = (anc(p1) ∪ anc(p2)) ∩ [0, lo)``,
+  since ``e ≥ lo``) — the rows are appended in topo order, so parents are
+  always already archived or earlier in the same batch.
+- :meth:`spill_full` — full-width rows straight from a batch rebase's
+  ``bool[N, N]`` slab (no reconstruction needed).
+
+Sees rows are **not** archived: ``sees(e, j) = anc(e, j) & ~forkseen(e,
+c(j))`` is derived on :meth:`fetch` from the archived ancestry row plus
+the global fork-pair ledger (the packer keeps every pair forever, and a
+pair discovered after ``e`` was archived cannot poison ``e`` — its second
+member is newer than ``e``, so ``e`` never descends from it).  Archiving
+one slab instead of two halves the archive.
+
+The archive is checkpointable (:meth:`save` / :meth:`load`, no pickle)
+and carries a running BLAKE2b digest of the appended blobs; ``load``
+verifies it, so a corrupt archive fails loudly at restore time instead of
+poisoning a later widening rebase.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import List
+
+import numpy as np
+
+from tpu_swirld import crypto, obs
+
+
+class SlabArchive:
+    """Append-only archive of decided ancestry rows (see module doc)."""
+
+    #: archive format version (bump on layout changes)
+    FORMAT_VERSION = 1
+
+    def __init__(self, compress_level: int = 1):
+        self._rows: List[bytes] = []       # zlib(packbits(row over [0, e]))
+        self._rounds: List[tuple] = []     # retired-round ledger
+        self._level = compress_level
+        self.spills = 0                    # spill batches accepted
+        self.fetches = 0                   # fetch calls served
+        self.spilled_rows = 0              # rows newly archived
+        self.fetched_rows = 0              # rows decompressed for callers
+        self.skipped_rows = 0              # re-spills of already-archived rows
+
+    # ------------------------------------------------------------- basics
+
+    @property
+    def n_rows(self) -> int:
+        """Archived prefix length: rows ``[0, n_rows)`` are archived."""
+        return len(self._rows)
+
+    @property
+    def archive_bytes(self) -> int:
+        """Total compressed payload bytes currently held."""
+        return sum(len(b) for b in self._rows)
+
+    def _row_bool(self, e: int) -> np.ndarray:
+        """Decompress row ``e`` to a bool[e + 1] ancestry bitmap."""
+        raw = np.frombuffer(zlib.decompress(self._rows[e]), dtype=np.uint8)
+        return np.unpackbits(raw, count=e + 1).astype(bool)
+
+    def _append_bool(self, row: np.ndarray) -> None:
+        self._rows.append(
+            zlib.compress(np.packbits(row).tobytes(), self._level)
+        )
+
+    # -------------------------------------------------------------- spill
+
+    def spill(
+        self, lo: int, parents: np.ndarray, rows: np.ndarray
+    ) -> int:
+        """Archive window rows for global events ``[lo, lo + d)``.
+
+        ``rows`` is bool[d, w] over retained columns ``[lo, lo + w)``;
+        ``parents`` is the int32[d, 2] *global* parent indices of those
+        events (-1 genesis).  Rows already archived (``e < n_rows`` —
+        possible after a widening rebase re-admitted them) are skipped:
+        ancestry is a pure DAG function, so the archived copy is already
+        the exact value.  Returns the number of rows newly archived.
+        """
+        d = rows.shape[0]
+        if lo + d <= self.n_rows or d == 0:
+            self.skipped_rows += d
+            return 0
+        added = 0
+        for i in range(d):
+            e = lo + i
+            if e < self.n_rows:
+                self.skipped_rows += 1
+                continue
+            if e != self.n_rows:
+                raise ValueError(
+                    f"non-contiguous spill: row {e} after {self.n_rows}"
+                )
+            full = np.zeros(e + 1, dtype=bool)
+            # pruned-prefix columns [0, lo) come from the parents' rows
+            # (earlier-archived, or appended earlier in this same batch);
+            # retained columns [lo, e] come straight from the device slab,
+            # which already includes the parent closure there
+            for p in parents[i]:
+                p = int(p)
+                if p < 0:
+                    continue
+                cut = min(p + 1, lo)
+                if cut > 0:
+                    full[:cut] |= self._row_bool(p)[:cut]
+            full[lo : e + 1] = rows[i, : e - lo + 1]
+            self._append_bool(full)
+            added += 1
+        self.spills += 1
+        self.spilled_rows += added
+        self._record_gauges()
+        return added
+
+    def spill_full(self, start: int, rows: np.ndarray) -> int:
+        """Archive full-width rows for global events ``[start, start+d)``
+        from a batch slab (bool[d, n] over global columns ``[0, n)``)."""
+        added = 0
+        for i in range(rows.shape[0]):
+            e = start + i
+            if e < self.n_rows:
+                self.skipped_rows += 1
+                continue
+            if e != self.n_rows:
+                raise ValueError(
+                    f"non-contiguous spill: row {e} after {self.n_rows}"
+                )
+            self._append_bool(rows[i, : e + 1])
+            added += 1
+        if added:
+            self.spills += 1
+            self.spilled_rows += added
+            self._record_gauges()
+        return added
+
+    # -------------------------------------------------------------- fetch
+
+    def fetch(
+        self, lo: int, hi: int, col_lo: int, col_hi: int
+    ) -> np.ndarray:
+        """Re-admit archived ancestry rows ``[lo, hi)`` over columns
+        ``[col_lo, col_hi)`` as a dense bool matrix (zero beyond each
+        row's own index — topo order)."""
+        if hi > self.n_rows:
+            raise ValueError(
+                f"fetch [{lo}, {hi}) exceeds archived prefix {self.n_rows}"
+            )
+        out = np.zeros((hi - lo, col_hi - col_lo), dtype=bool)
+        for i, e in enumerate(range(lo, hi)):
+            row = self._row_bool(e)
+            a = min(col_hi, e + 1)
+            if a > col_lo:
+                out[i, : a - col_lo] = row[col_lo:a]
+        self.fetches += 1
+        self.fetched_rows += hi - lo
+        o = obs.current()
+        if o is not None:
+            o.registry.counter("store_fetches_total").inc()
+            o.registry.counter("store_fetched_rows_total").inc(hi - lo)
+        return out
+
+    @staticmethod
+    def derive_sees(
+        anc_rows: np.ndarray,
+        col_lo: int,
+        creator: np.ndarray,
+        fork_pairs: np.ndarray,
+        n_members: int,
+    ) -> np.ndarray:
+        """Fork-aware visibility for fetched rows: ``sees = anc &
+        ~forkseen[:, creator(col)]``.
+
+        ``anc_rows`` is bool[d, c] over global columns ``[col_lo, col_lo +
+        c)``; ``creator`` the global creator indices of those columns;
+        ``fork_pairs`` the **global** int32[G, 3] ledger.  Pairs with a
+        member outside the column span cannot poison these rows (the
+        fetched rows never descend from anything outside ``[0, col_lo +
+        c)``, and members below ``col_lo`` were below every archived
+        row's own pruned prefix — the packer pins pairs above the prune
+        boundary, so the span always covers every applicable pair).
+        """
+        d, c = anc_rows.shape
+        fseen = np.zeros((d, n_members), dtype=bool)
+        for m, a, b in fork_pairs:
+            a, b = int(a) - col_lo, int(b) - col_lo
+            if 0 <= a < c and 0 <= b < c:
+                fseen[:, int(m)] |= anc_rows[:, a] & anc_rows[:, b]
+        return anc_rows & ~fseen[:, creator]
+
+    # ------------------------------------------------------- round ledger
+
+    # The witness-round ledger mirrors the visibility archive at round
+    # granularity: when the driver rolls a fame-complete round out of its
+    # retained window, the row lands here (global round, witness event
+    # indices in registration order, famous flags, decided_at).  It is
+    # report/checkpoint metadata — the widening rebase never re-votes
+    # committed rounds (a straggler below the frozen horizon takes the
+    # full-rebase path instead).
+
+    def retire_round(
+        self, rnd: int, events, famous, decided_at
+    ) -> None:
+        self._rounds.append(
+            (int(rnd), list(map(int, events)), list(map(int, famous)),
+             list(map(int, decided_at)))
+        )
+
+    @property
+    def retired_rounds(self) -> int:
+        return len(self._rounds)
+
+    # --------------------------------------------------------- checkpoint
+
+    def digest(self) -> str:
+        """BLAKE2b over the blob stream (order-sensitive)."""
+        h = b""
+        for b in self._rows:
+            h = crypto.hash_bytes(h + crypto.hash_bytes(b))
+        return h.hex()
+
+    def save(self, path: str) -> None:
+        """Single ``.npz``, no pickle: length-prefixed blob stream +
+        round ledger + digest."""
+        blob = b"".join(
+            struct.pack("<I", len(b)) + b for b in self._rows
+        )
+        rounds = self._rounds
+        rmeta = []
+        rflat: List[int] = []
+        for rnd, evs, fam, dec in rounds:
+            rmeta.append((rnd, len(evs)))
+            for e, f, dc in zip(evs, fam, dec):
+                rflat.extend((e, f, dc))
+        # write through a file object: np.savez_compressed appends ".npz"
+        # to bare string paths, which would break save(p)/load(p) round
+        # trips for any other suffix
+        with open(path, "wb") as f:
+            np.savez_compressed(
+                f,
+                format_version=self.FORMAT_VERSION,
+                n_rows=self.n_rows,
+                blobs=np.frombuffer(blob, dtype=np.uint8),
+                round_meta=np.asarray(rmeta, dtype=np.int64).reshape(-1, 2),
+                round_flat=np.asarray(rflat, dtype=np.int64),
+                digest=np.frombuffer(self.digest().encode(), dtype=np.uint8),
+            )
+
+    @classmethod
+    def load(cls, path: str) -> "SlabArchive":
+        """Restore and **verify**: a digest mismatch (tampered or corrupt
+        archive) raises ``ValueError`` instead of silently feeding wrong
+        ancestry into a later widening rebase."""
+        z = np.load(path)
+        if int(z["format_version"]) != cls.FORMAT_VERSION:
+            raise ValueError(
+                f"unsupported archive version {int(z['format_version'])}"
+            )
+        arch = cls()
+        blob = z["blobs"].tobytes()
+        off = 0
+        while off < len(blob):
+            (ln,) = struct.unpack_from("<I", blob, off)
+            off += 4
+            arch._rows.append(blob[off : off + ln])
+            off += ln
+        if arch.n_rows != int(z["n_rows"]):
+            raise ValueError(
+                f"archive truncated: {arch.n_rows} rows, header says "
+                f"{int(z['n_rows'])}"
+            )
+        want = z["digest"].tobytes().decode()
+        got = arch.digest()
+        if got != want:
+            raise ValueError(
+                "archive digest mismatch (corrupt or tampered checkpoint)"
+            )
+        rmeta = z["round_meta"]
+        rflat = z["round_flat"]
+        pos = 0
+        for rnd, cnt in rmeta:
+            evs, fam, dec = [], [], []
+            for _ in range(int(cnt)):
+                e, f, dc = rflat[pos : pos + 3]
+                evs.append(int(e))
+                fam.append(int(f))
+                dec.append(int(dc))
+                pos += 3
+            arch.retire_round(int(rnd), evs, fam, dec)
+        return arch
+
+    # ---------------------------------------------------------------- obs
+
+    def _record_gauges(self) -> None:
+        o = obs.current()
+        if o is None:
+            return
+        g = o.registry
+        g.gauge("store_archived_rows").set(self.n_rows)
+        g.gauge("store_archive_bytes").set(self.archive_bytes)
+        g.counter("store_spills_total").inc()

@@ -1,0 +1,211 @@
+"""The fixed tile-budget API over the resident visibility slabs.
+
+The streaming driver's device state is three window slabs — ancestry
+``bool[W, W]``, sees ``bool[W, W]``, strongly-sees columns ``bool[W, C]``
+— plus the per-member gather slabs ``a3 (M, W, K)`` / ``b3 (M, K, W)``.
+:class:`SlabStore` accounts them in ``tile × tile`` tiles, exposes the
+``resident_tiles`` / ``spill`` / ``fetch`` surface the driver consumes,
+and (optionally, ``strict=True``) refuses window growth past
+``budget_tiles``: row capacity, ssm column capacity, member k-slots, and
+widening rebases are all budget-checked before they commit.  The one
+exempt path is the full-batch rebase fallback (straggler witnesses below
+the frozen vote horizon, late genesis): it allocates batch-scale slabs by
+design and cannot occur for honest traffic; its footprint still lands in
+``peak_resident_*`` after the fact.
+
+``spill`` retires decided rows into the :class:`~tpu_swirld.store.archive.
+SlabArchive`; ``fetch`` re-admits archived rows, reconstructing the
+fork-aware sees values from the global fork-pair ledger.  Both are exact:
+ancestry/sees are pure DAG functions, so a row's archived value equals
+what a cold batch pass would recompute.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from tpu_swirld import obs
+from tpu_swirld.store.archive import SlabArchive
+
+
+class TileBudgetExceeded(RuntimeError):
+    """Raised (``strict`` mode) when a window growth or widening rebase
+    would push the resident slab tiles past the configured budget."""
+
+
+def _tiles(shape: Tuple[int, ...], tile: int) -> int:
+    """Tile count of one slab: product of per-axis ceil(dim / tile) over
+    the last two axes, times any leading (member) axes."""
+    if not shape:
+        return 0
+    lead = 1
+    for d in shape[:-2]:
+        lead *= d
+    grid = 1
+    for d in shape[-2:]:
+        grid *= -(-d // tile)
+    return lead * grid
+
+
+@dataclasses.dataclass
+class _Slab:
+    shape: Tuple[int, ...]
+    itemsize: int
+
+    @property
+    def nbytes(self) -> int:
+        n = self.itemsize
+        for d in self.shape:
+            n *= d
+        return n
+
+
+class SlabStore:
+    """Tile accounting + budget + archive orchestration (see module doc).
+
+    ``budget_tiles``: total resident visibility tiles allowed (``None`` =
+    unbounded, accounting only).  ``strict``: raise
+    :class:`TileBudgetExceeded` on a growth that would exceed the budget;
+    otherwise the overflow is counted (``budget_overruns``) and the run
+    continues — the honest-traffic invariant is asserted by tests, the
+    hard stop is an opt-in for deployments that prefer fail-stop to
+    swap-death.
+    """
+
+    def __init__(
+        self,
+        budget_tiles: Optional[int] = None,
+        *,
+        tile: int = 256,
+        strict: bool = False,
+        archive: Optional[SlabArchive] = None,
+    ):
+        self.tile = int(tile)
+        self.budget_tiles = budget_tiles
+        self.strict = strict
+        self.archive = archive if archive is not None else SlabArchive()
+        self._slabs: Dict[str, _Slab] = {}
+        self.budget_overruns = 0
+        self.peak_resident_tiles = 0
+        self.peak_resident_bytes = 0
+
+    # --------------------------------------------------------- accounting
+
+    def account(self, name: str, shape: Tuple[int, ...], itemsize: int = 1):
+        """Register/refresh one resident slab's shape (driver calls this
+        whenever a slab is (re)allocated or grown)."""
+        self._slabs[name] = _Slab(tuple(int(d) for d in shape), itemsize)
+        self._touch()
+
+    @property
+    def resident_tiles(self) -> int:
+        return sum(_tiles(s.shape, self.tile) for s in self._slabs.values())
+
+    @property
+    def resident_bytes(self) -> int:
+        return sum(s.nbytes for s in self._slabs.values())
+
+    def check(self, prospective: Dict[str, Tuple[int, ...]]) -> bool:
+        """Would the slabs, with ``prospective`` shape overrides, fit the
+        budget?  In ``strict`` mode an overflow raises; otherwise it is
+        counted and ``False`` returned."""
+        if self.budget_tiles is None:
+            return True
+        total = 0
+        for name, slab in self._slabs.items():
+            shape = prospective.get(name, slab.shape)
+            total += _tiles(shape, self.tile)
+        for name, shape in prospective.items():
+            if name not in self._slabs:
+                total += _tiles(shape, self.tile)
+        if total <= self.budget_tiles:
+            return True
+        self.budget_overruns += 1
+        o = obs.current()
+        if o is not None:
+            o.registry.counter("store_budget_overruns_total").inc()
+        if self.strict:
+            raise TileBudgetExceeded(
+                f"resident slabs would need {total} tiles "
+                f"(budget {self.budget_tiles}, tile {self.tile}); raise the "
+                "budget or lower the ingest chunk / prune threshold"
+            )
+        return False
+
+    def _touch(self) -> None:
+        rt, rb = self.resident_tiles, self.resident_bytes
+        self.peak_resident_tiles = max(self.peak_resident_tiles, rt)
+        self.peak_resident_bytes = max(self.peak_resident_bytes, rb)
+        o = obs.current()
+        if o is not None:
+            g = o.registry
+            g.gauge("store_resident_tiles").set(rt)
+            g.gauge("store_resident_bytes").set(rb)
+
+    # ------------------------------------------------------ spill / fetch
+
+    def spill(self, lo: int, parents: np.ndarray, rows: np.ndarray) -> int:
+        """Retire decided window rows ``[lo, lo + d)`` into the archive
+        (see :meth:`SlabArchive.spill`)."""
+        added = self.archive.spill(lo, parents, rows)
+        o = obs.current()
+        if o is not None and added:
+            o.registry.counter("store_spilled_rows_total").inc(added)
+        return added
+
+    def spill_full(self, start: int, rows: np.ndarray) -> int:
+        added = self.archive.spill_full(start, rows)
+        o = obs.current()
+        if o is not None and added:
+            o.registry.counter("store_spilled_rows_total").inc(added)
+        return added
+
+    def fetch(
+        self,
+        lo: int,
+        hi: int,
+        col_lo: int,
+        col_hi: int,
+        *,
+        creator: Optional[np.ndarray] = None,
+        fork_pairs: Optional[np.ndarray] = None,
+        n_members: int = 0,
+    ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """Re-admit archived rows ``[lo, hi)`` over columns ``[col_lo,
+        col_hi)``.  Returns ``(anc_rows, sees_rows)``; sees is derived
+        when ``creator`` (global creator index per column) is given, else
+        ``None``."""
+        anc = self.archive.fetch(lo, hi, col_lo, col_hi)
+        sees = None
+        if creator is not None:
+            fp = (
+                fork_pairs
+                if fork_pairs is not None
+                else np.zeros((0, 3), np.int32)
+            )
+            sees = SlabArchive.derive_sees(
+                anc, col_lo, creator, fp, n_members
+            )
+        return anc, sees
+
+    # ------------------------------------------------------------- report
+
+    def stats(self) -> Dict:
+        return {
+            "tile": self.tile,
+            "budget_tiles": self.budget_tiles,
+            "resident_tiles": self.resident_tiles,
+            "resident_bytes": self.resident_bytes,
+            "peak_resident_tiles": self.peak_resident_tiles,
+            "peak_resident_bytes": self.peak_resident_bytes,
+            "budget_overruns": self.budget_overruns,
+            "archived_rows": self.archive.n_rows,
+            "archive_bytes": self.archive.archive_bytes,
+            "spills": self.archive.spills,
+            "fetches": self.archive.fetches,
+            "spilled_rows": self.archive.spilled_rows,
+            "fetched_rows": self.archive.fetched_rows,
+        }

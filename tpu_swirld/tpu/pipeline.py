@@ -1811,6 +1811,24 @@ class IncrementalConsensus:
         self._consec_rebases = 0
         self._storm_left = 0
 
+    # ------------------------------------------- capacity growth policy
+    #
+    # Single source of truth for the next-capacity formulas: the
+    # streaming driver's budget pre-checks predict the exact shapes these
+    # produce, so any policy change here must stay in one place.
+
+    @staticmethod
+    def _next_row_pad(need: int, window_bucket: int) -> int:
+        return _bucket(need + window_bucket // 2, window_bucket)
+
+    @staticmethod
+    def _next_col_cap(n_cols: int, batch: int, cap: int) -> int:
+        return _bucket(max(n_cols + batch, cap * 2), 256)
+
+    @staticmethod
+    def _next_k_cap(need: int) -> int:
+        return _bucket(need + 4, 8)
+
     # -------------------------------------------------------- public API
 
     def __len__(self) -> int:
@@ -1829,6 +1847,32 @@ class IncrementalConsensus:
         """True while the rebase-storm guard holds the driver in
         full-recompute mode."""
         return self._storm_left > 0
+
+    @property
+    def resident_visibility_bytes(self) -> int:
+        """Bytes of device-resident visibility state (the anc/sees/ssm
+        window slabs plus the per-member gather slabs) — the quantity the
+        slab store's tile budget bounds.  Zero before the first pass."""
+        if not self._initialized:
+            return 0
+        return int(
+            self._anc_d.nbytes + self._sees_d.nbytes + self._ssm_d.nbytes
+            + self._a3_d.nbytes + self._b3_d.nbytes
+        )
+
+    # Retirement hooks: no-ops here; :class:`tpu_swirld.store.streaming.
+    # StreamingConsensus` overrides them to archive decided rows / rounds
+    # instead of discarding them.  Called with the PRE-mutation state.
+
+    def _on_prune(self, d: int, w_used: int) -> None:
+        """About to drop window rows [0, d) of [0, w_used)."""
+
+    def _on_roll(self, dr: int) -> None:
+        """About to roll witness-table rows [0, dr) out of the window."""
+
+    def _on_rebase(self, packed, out, aux) -> None:
+        """A batch rebase decided everything up to the new ``self._lo``;
+        ``aux`` still holds the full-DAG device slabs."""
 
     def ingest(self, events=()) -> Dict:
         """Feed a topo-ordered gossip delta; run one incremental pass.
@@ -1997,7 +2041,7 @@ class IncrementalConsensus:
     def _ensure_row_capacity(self, need: int) -> None:
         if need <= self._w_pad:
             return
-        new_pad = _bucket(need + self._window_bucket // 2, self._window_bucket)
+        new_pad = self._next_row_pad(need, self._window_bucket)
         g = new_pad - self._w_pad
         self._anc_d = jnp.pad(self._anc_d, ((0, g), (0, g)))
         self._sees_d = jnp.pad(self._sees_d, ((0, g), (0, g)))
@@ -2035,7 +2079,7 @@ class IncrementalConsensus:
         self._colpos_w = np.full((w_pad,), -1, np.int32)
 
     def _grow_k(self, need: int) -> None:
-        new_k = _bucket(need + 4, 8)
+        new_k = self._next_k_cap(need)
         out = np.full((self._m, new_k), -1, np.int32)
         out[:, : self._k_cap] = self._mt_np
         self._mt_np = out
@@ -2065,8 +2109,8 @@ class IncrementalConsensus:
             return
         batch = _bucket(len(events), 16)
         if self._n_cols + batch > self._wcol_cap:
-            new_cap = _bucket(
-                max(self._n_cols + batch, self._wcol_cap * 2), 256
+            new_cap = self._next_col_cap(
+                self._n_cols, batch, self._wcol_cap
             )
             self._ssm_d = jnp.pad(
                 self._ssm_d, ((0, 0), (0, new_cap - self._wcol_cap))
@@ -2359,6 +2403,8 @@ class IncrementalConsensus:
         return ordered_new, False
 
     def _roll_rounds(self, dr: int) -> None:
+        self._on_roll(dr)
+
         def roll(a, fill):
             out = np.full_like(a, fill)
             out[:-dr] = a[dr:]
@@ -2382,6 +2428,7 @@ class IncrementalConsensus:
             d = min(d, int(self._fork_np[:, 1:].min()))
         if d < self._prune_min:
             return
+        self._on_prune(d, w_used)
         keep = np.full((self._wcol_cap,), -1, np.int32)
         kept_events: List[int] = []
         j = 0
@@ -2524,6 +2571,7 @@ class IncrementalConsensus:
             lo = min(lo, int(packed.fork_pairs[:, 1:].min()))
         self._lo = lo
         self._r_base = cr
+        self._on_rebase(packed, out, aux)
         w_used = n - lo
         self._w_pad = max(
             self._w_pad,
