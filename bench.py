@@ -363,10 +363,17 @@ def run_stream(tile_budget, tile):
                 f"{' REBASE' if st['rebased'] else ''}")
     t_stream = time.time() - t_all
     stream_evps = n_done / t_stream
+    # overlap ratio over the whole run: fraction of the stream wall spent
+    # computing rather than blocked behind the archive's spill queue
+    # (snapshot stall BEFORE close() — the final flush is off the clock)
+    stall = inc.store.archive.stall_seconds
+    overlap = max(0.0, min(1.0, (t_stream - stall) / t_stream))
     res = inc.result()
     log(f"[stream] {n_done} ev in {t_stream:.1f}s = {stream_evps:.0f} ev/s; "
         f"ordered {len(res.order)}, max_round {res.max_round}, "
-        f"pruned {inc.pruned_prefix}, window {inc.window_size}")
+        f"pruned {inc.pruned_prefix}, window {inc.window_size}, "
+        f"overlap {overlap:.3f}")
+    inc.store.close()       # flush background packing before stats/parity
 
     with mon.phase("oracle_subsample"):
         new_ids = [ev.id for ev in oracle_buf if oracle.add_event(ev)]
@@ -402,6 +409,11 @@ def run_stream(tile_budget, tile):
         "peak_host_bytes": mon.peak_host_bytes,
         "peak_device_bytes": mon.peak_device_bytes,
         "stream": {
+            "evps": round(stream_evps, 1),
+            "overlap_ratio": round(overlap, 4),
+            "spill_pack_seconds": stats["spill_pack_seconds"],
+            "spill_stall_seconds": stats["spill_stall_seconds"],
+            "spill_queue_depth_peak": stats["spill_queue_depth_peak"],
             "members": STREAM_MEMBERS,
             "events": n_done,
             "chunk": STREAM_CHUNK,

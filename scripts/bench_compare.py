@@ -11,11 +11,18 @@ headline events/sec) and exits nonzero when the new number is more than
 ``threshold`` (default 10%) below the old one.  Also compared, when both
 files carry them:
 
-- ``incremental.steady_evps`` (higher is better — drop >threshold fails);
+- ``incremental.steady_evps`` and ``stream.evps`` (higher is better — a
+  drop >threshold fails, so the streaming config-5 throughput is gated
+  exactly like the batch headline);
 - the peak-memory metrics ``peak_host_bytes`` / ``peak_device_bytes`` /
   ``stream.peak_resident_visibility_bytes`` (LOWER is better — a rise
   >threshold fails, so a change that silently re-materializes an
   O(N²) slab trips the gate even when throughput improves).
+
+Driver artifacts that wrap the bench line (``{"cmd": ..., "parsed":
+{...}}`` — the BENCH_rNN.json files) are unwrapped automatically, so
+``bench_compare.py BENCH_r05.json /tmp/BENCH_new.json`` works on the
+checked-in history directly.
 
 Everything else (phases, window stats) is printed as an informational
 diff.
@@ -41,10 +48,18 @@ from typing import Any, Dict, Optional
 #: direction from throughput keys
 EXTRA_KEYS = [
     ("incremental.steady_evps", True),
+    ("stream.evps", True),
     ("peak_host_bytes", False),
     ("peak_device_bytes", False),
     ("stream.peak_resident_visibility_bytes", False),
 ]
+
+
+def unwrap(doc: Dict) -> Dict:
+    """Driver artifacts wrap the bench JSON line under ``parsed``."""
+    if "value" not in doc and isinstance(doc.get("parsed"), dict):
+        return doc["parsed"]
+    return doc
 
 
 def _get(d: Dict[str, Any], dotted: str) -> Optional[float]:
@@ -98,9 +113,9 @@ def main(argv=None) -> int:
                     help="headline metric key (default: value)")
     args = ap.parse_args(argv)
     with open(args.old) as f:
-        old = json.load(f)
+        old = unwrap(json.load(f))
     with open(args.new) as f:
-        new = json.load(f)
+        new = unwrap(json.load(f))
     failures, lines = compare(old, new, args.key, args.threshold)
     for ln in lines:
         print(ln)

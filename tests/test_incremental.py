@@ -232,30 +232,40 @@ def test_incremental_matches_oracle_incremental_view():
     assert committed == inc.result().order
 
 
-def test_member_slab_extend_pad_rows_do_not_clobber_slot_zero():
-    """Review regression: scatter padding rows used to be clipped onto
-    (member 0, slot 0), racing a genuine write there (an idle member
-    whose pruned window refills from slot 0).  Pads must be dropped."""
+def test_ssm_block_suffix_cut_matches_full_height():
+    """The suffix-row cut of the witness-column adds relies on rows below
+    a column's event being structurally unable to strongly-see it: the
+    full-height block restricted to those rows must be identically zero,
+    so the partial write plus the slab's zero-fill equals the full
+    computation."""
     import jax.numpy as jnp
 
-    from tpu_swirld.tpu.pipeline import member_slabs, member_slabs_extend_stage
+    from tpu_swirld.tpu.pipeline import ssm_block_stage
 
-    n = 8
-    sees = np.zeros((n, n), dtype=bool)
-    for i in range(4):
-        sees[i, : i + 1] = True            # event i sees 0..i
-    mt = np.array([[3, -1], [0, 1]], dtype=np.int32)   # member0 slot0 = new ev 3
-    a3_0, b3_0 = member_slabs(jnp.asarray(sees), jnp.asarray(mt))
-    # start from slabs that do NOT know event 3 yet, extend with it + pads
-    mt_old = np.array([[-1, -1], [0, 1]], dtype=np.int32)
-    a3, b3 = member_slabs(jnp.asarray(sees), jnp.asarray(mt_old))
-    rows = 4                               # row block [3, 7): 1 real + 3 pads
-    zm = np.array([0, -1, -1, -1], np.int32)
-    zk = np.array([0, -1, -1, -1], np.int32)
-    ze = np.array([3, -1, -1, -1], np.int32)
-    a3, b3 = member_slabs_extend_stage(
-        a3, b3, jnp.asarray(sees), jnp.asarray(mt), np.int32(3),
-        jnp.asarray(zm), jnp.asarray(zk), jnp.asarray(ze), rows=rows,
-    )
-    assert (np.asarray(b3) == np.asarray(b3_0)).all()
-    assert (np.asarray(a3) == np.asarray(a3_0)).all()
+    members, stake, events, _keys = generate_gossip_dag(8, 200, seed=11)
+    packed = pack_events(events, members, stake)
+    n = 256
+    parents = np.full((n, 2), -1, np.int32)
+    parents[: packed.n] = packed.parents
+    from tpu_swirld.tpu.pipeline import ancestry
+
+    sees = ancestry(jnp.asarray(parents), block=64,
+                    matmul_dtype=jnp.float32)
+    mt = np.full((8, 32), -1, np.int32)
+    mt[:, : packed.member_table.shape[1]] = packed.member_table
+    cols = np.asarray([150, 170, 190], np.int32)
+    tot = int(stake if np.isscalar(stake) else np.sum(packed.stake))
+    full = np.asarray(ssm_block_stage(
+        sees, jnp.asarray(mt), jnp.asarray(packed.stake),
+        jnp.asarray(cols), np.int32(0), rows=n, tot_stake=tot,
+        matmul_dtype_name="float32",
+    ))
+    # rows below the earliest column event are all zero -> the suffix
+    # write is exact
+    assert not full[:150].any()
+    suffix = np.asarray(ssm_block_stage(
+        sees, jnp.asarray(mt), jnp.asarray(packed.stake),
+        jnp.asarray(cols), np.int32(128), rows=128, tot_stake=tot,
+        matmul_dtype_name="float32",
+    ))
+    assert (suffix == full[128:]).all()

@@ -82,47 +82,78 @@ def test_full_pipeline_with_pallas_ssm_parity():
     assert_parity(node, packed, result)
 
 
-def test_pallas_ssm_cols_matches_xla_cols():
-    """The Pallas column kernel must equal the XLA ssm_cols_stage exactly
-    over the same pre-gathered member slabs."""
-    from tpu_swirld.tpu.pallas_kernels import ssm_cols_pallas
-    from tpu_swirld.tpu.pipeline import member_slabs, ssm_cols_stage
+def test_pallas_ssm_block_matches_xla_block():
+    """The Pallas block kernel must equal the XLA ssm_block_stage exactly
+    — same sees-slab gathers, same member hops — at ragged edge shapes:
+    a row suffix that is not tile-aligned, a single-column batch, and a
+    full-height block."""
+    from tpu_swirld.tpu.pallas_kernels import ssm_block_pallas
+    from tpu_swirld.tpu.pipeline import ssm_block_stage
 
     packed, sees = _sees_from_sim(5, 220, seed=3)
     tot = int(packed.stake.sum())
-    a3, b3 = member_slabs(sees, jnp.asarray(packed.member_table))
     n = sees.shape[0]
-    cols = np.full((128,), -1, np.int32)
+    mt = jnp.asarray(packed.member_table)
+    stake = jnp.asarray(packed.stake)
     picks = np.linspace(0, packed.n - 1, 100).astype(np.int32)
-    cols[: len(picks)] = picks
-    want = ssm_cols_stage(
-        a3, b3, jnp.asarray(packed.stake), jnp.asarray(cols),
-        tot_stake=tot, matmul_dtype_name="float32",
-    )
-    got = ssm_cols_pallas(
-        a3, b3, jnp.asarray(packed.stake), jnp.asarray(cols),
-        tot_stake=tot, matmul_dtype_name="float32",
-        tile_m=128, tile_n=128, interpret=INTERPRET,
-    )
-    assert (np.asarray(got) == np.asarray(want)).all()
+    cases = [
+        (0, n, np.concatenate([picks, np.full(28, -1, np.int32)])),
+        (n - 128, 128, picks[:16]),            # suffix block
+        (n - 64, 64, picks[:16]),              # sub-tile suffix
+        # odd offset + the driver's minimum column batch (one real column
+        # bucketed to 16 — the single-event-chunk shape)
+        (32, 96, np.concatenate([picks[:1], np.full(15, -1, np.int32)])),
+    ]
+    for row0, rows, cols in cases:
+        want = ssm_block_stage(
+            sees, mt, stake, jnp.asarray(cols), np.int32(row0), rows=rows,
+            tot_stake=tot, matmul_dtype_name="float32",
+        )
+        got = ssm_block_pallas(
+            sees, mt, stake, jnp.asarray(cols), np.int32(row0), rows=rows,
+            tot_stake=tot, matmul_dtype_name="float32",
+            tile_m=128, tile_n=128, interpret=INTERPRET,
+        )
+        assert (np.asarray(got) == np.asarray(want)).all(), (row0, rows)
 
 
-def test_incremental_with_pallas_cols_parity():
-    """IncrementalConsensus with the Pallas column kernel as its
-    strongly-sees backend: bit-parity with full recompute."""
-    from tpu_swirld.tpu.pallas_kernels import make_ssm_cols_fn
+def test_pallas_bmm_matches_xla():
+    """The tiled boolean-matmul hop (ancestry extension) is exact against
+    the straight XLA matmul, including a non-128 contraction axis."""
+    from tpu_swirld.tpu.pallas_kernels import bmm_or_pallas
+    from tpu_swirld.tpu.pipeline import _bmm
+
+    rng = np.random.default_rng(5)
+    for p, q, r in [(128, 128, 256), (64, 96, 128), (128, 64, 512)]:
+        a = jnp.asarray(rng.random((p, q)) < 0.1)
+        b = jnp.asarray(rng.random((q, r)) < 0.1)
+        want = _bmm(a, b, jnp.float32)
+        got = bmm_or_pallas(a, b, jnp.float32, interpret=INTERPRET)
+        assert (np.asarray(got) == np.asarray(want)).all(), (p, q, r)
+
+
+def test_incremental_with_pallas_block_parity():
+    """IncrementalConsensus with the full Pallas extension-kernel bundle
+    (ancestry bmm hop + strongly-sees block) as its hot-path backend:
+    bit-parity with full recompute."""
+    from tpu_swirld.tpu.pallas_kernels import make_extension_kernels
     from tpu_swirld.tpu.pipeline import IncrementalConsensus, run_consensus
 
-    sim = make_simulation(5, seed=17)
-    sim.run(220)
+    # 5 members + a forker: the forked fused stage's one-hot hop is only
+    # n_members wide, which the bmm grid cannot tile — it must fall back
+    # to the XLA matmul instead of crashing (small-network regression)
+    sim = run_with_forkers(5, 1, 220, seed=17)
     node = sim.nodes[0]
     packed = pack_node(node)
+    assert len(packed.fork_pairs) > 0
     events = [node.hg[e] for e in node.order_added]
     stake = [node.stake[m] for m in node.members]
     inc = IncrementalConsensus(
         node.members, stake, node.config, block=64, chunk=64,
         window_bucket=256, prune_min=64,
-        ssm_cols_fn=make_ssm_cols_fn(interpret=INTERPRET),
+        extension_kernels=make_extension_kernels(
+            interpret=INTERPRET, tile_m=128, tile_n=128
+        ),
     )
     for i in range(0, len(events), 80):
         inc.ingest(events[i : i + 80])

@@ -69,10 +69,10 @@ def test_config5_shape_256_members_sharded():
 
 @pytest.mark.skipif(len(jax.devices()) < 4, reason="needs 4 virtual devices")
 def test_incremental_with_mesh_cols_parity():
-    """IncrementalConsensus with the member-sharded strongly-sees column
+    """IncrementalConsensus with the member-sharded strongly-sees block
     kernel (shard_map + psum): bit-parity with full recompute, including
     a member count that needs mesh padding (6 members on 4 devices)."""
-    from tpu_swirld.parallel import make_ssm_cols_fn_for_mesh
+    from tpu_swirld.parallel import make_ssm_block_fn_for_mesh
     from tpu_swirld.tpu.pipeline import IncrementalConsensus
 
     sim = make_simulation(6, seed=19)
@@ -84,7 +84,7 @@ def test_incremental_with_mesh_cols_parity():
     inc = IncrementalConsensus(
         node.members, stake, node.config, block=64, chunk=64,
         window_bucket=256, prune_min=64,
-        ssm_cols_fn=make_ssm_cols_fn_for_mesh(make_mesh(4)),
+        ssm_block_fn=make_ssm_block_fn_for_mesh(make_mesh(4)),
     )
     for i in range(0, len(events), 80):
         inc.ingest(events[i : i + 80])

@@ -350,7 +350,7 @@ def test_archive_checkpoint_roundtrip_and_digest_tamper(tmp_path):
     )
     _np.savez_compressed(
         p2, format_version=SlabArchive.FORMAT_VERSION,
-        n_rows=tampered.n_rows,
+        n_rows=len(tampered._rows),
         blobs=_np.frombuffer(raw, dtype=_np.uint8),
         round_meta=_np.zeros((0, 2), _np.int64),
         round_flat=_np.zeros((0,), _np.int64),
@@ -358,6 +358,110 @@ def test_archive_checkpoint_roundtrip_and_digest_tamper(tmp_path):
     )
     with pytest.raises(ValueError, match="digest"):
         load_archive(str(p2))
+
+
+def test_archive_settings_config_and_env(monkeypatch):
+    """Archive knobs resolve explicit SwirldConfig field > SWIRLD_ARCHIVE_*
+    env var > built-in default, and reach the SlabArchive instance."""
+    from tpu_swirld.config import resolve_archive_settings
+
+    monkeypatch.setenv("SWIRLD_ARCHIVE_COMPRESS_LEVEL", "9")
+    monkeypatch.setenv("SWIRLD_ARCHIVE_QUEUE_DEPTH", "3")
+    monkeypatch.setenv("SWIRLD_ARCHIVE_ASYNC", "0")
+    assert resolve_archive_settings(None) == {
+        "compress_level": 9, "queue_depth": 3, "async_spill": False,
+    }
+    for off in ("false", "False", "OFF", "no", ""):
+        monkeypatch.setenv("SWIRLD_ARCHIVE_ASYNC", off)
+        assert resolve_archive_settings(None)["async_spill"] is False
+    monkeypatch.setenv("SWIRLD_ARCHIVE_ASYNC", "1")
+    assert resolve_archive_settings(None)["async_spill"] is True
+    cfg = SwirldConfig(
+        n_members=4, archive_compress_level=2, archive_async=True,
+    )
+    s = resolve_archive_settings(cfg)
+    assert s["compress_level"] == 2          # explicit field wins
+    assert s["async_spill"] is True
+    assert s["queue_depth"] == 3             # env fills the unset field
+    arch = SlabArchive(config=cfg)
+    assert arch._level == 2 and arch._async is True and arch.queue_depth == 3
+
+
+def test_overlapped_vs_serial_ingest_bit_identical():
+    """The background packing worker must be unobservable: async and sync
+    spilling produce the identical archive blob stream (digest) and the
+    drivers' outputs match bit-for-bit — across forks, random chunking,
+    and a widening rebase mid-flight."""
+    members, stake, events, keys = generate_gossip_dag(
+        8, 1600, seed=5, n_forkers=1
+    )
+    pk0, sk0 = keys[0]
+    head0 = [ev for ev in events if ev.c == pk0][-1]
+    strag = Event(
+        d=b"stale-overlap", p=(head0.id, events[80].id),
+        t=events[-1].t + 1, c=pk0,
+    ).signed(sk0)
+    runs = {}
+    for flag in (True, False):
+        cfg = SwirldConfig(n_members=8, archive_async=flag)
+        inc = StreamingConsensus(
+            members, stake, cfg, chunk=64, window_bucket=256,
+            prune_min=64, ingest_chunk=256,
+        )
+        for chunk in random_chunks(events, 13, (5, 40, 120, 250)):
+            st = inc.ingest(chunk)
+        assert "overlap_ratio" in st and "spill_queue_depth" in st
+        assert 80 < inc.pruned_prefix       # the straggler ref is archived
+        inc.ingest([strag])
+        assert inc.widen_rebases == 1       # widening fired mid-flight
+        inc.store.close()                   # flush the packing worker
+        runs[flag] = inc
+    a, s = runs[True], runs[False]
+    assert_same_result(a.result(), s.result())
+    assert a.store.archive.n_rows == s.store.archive.n_rows
+    assert a.store.archive.digest() == s.store.archive.digest()
+    assert_same_result(
+        a.result(),
+        run_consensus(pack_events(events + [strag], members, stake),
+                      SwirldConfig(n_members=8)),
+    )
+
+
+def test_checkpoint_with_nonempty_spill_queue_drains(tmp_path):
+    """Drain-barrier regression: a checkpoint taken while spill batches
+    are still queued behind a stalled worker must persist every accepted
+    row — and the blob stream must equal a synchronous spiller's."""
+    import threading
+
+    from tpu_swirld.checkpoint import load_archive, save_archive
+
+    rng = np.random.default_rng(0)
+    rows = np.tril(rng.random((64, 64)) < 0.3)
+    sync = SlabArchive(async_spill=False)
+    sync.spill_full(0, rows)
+
+    arch = SlabArchive(async_spill=True, queue_depth=8)
+    gate = threading.Event()
+    orig = arch._pack_full_rows
+
+    def gated(start, r):
+        gate.wait(10)
+        orig(start, r)
+
+    arch._pack_full_rows = gated
+    for s in range(0, 64, 16):
+        arch.spill_full(s, rows[s : s + 16])
+    assert arch.n_rows == 64                # accepted, not yet packed
+    assert arch.pending_batches >= 1        # queue genuinely non-empty
+    assert arch.committed_rows < arch.n_rows
+    threading.Timer(0.2, gate.set).start()
+    p = tmp_path / "arch.npz"
+    save_archive(str(p), arch)              # the drain barrier waits here
+    assert arch.committed_rows == 64
+    back = load_archive(str(p))
+    assert back.n_rows == 64
+    assert back.digest() == sync.digest()   # byte-identical blob stream
+    arch.close()
 
 
 def test_stream_gossip_dag_matches_batch_generator():

@@ -5,12 +5,48 @@ stake passed to ``Node.__init__``, sim sizes as function args — SURVEY.md §5
 "Config / flag system: none").  Here they live in one dataclass shared by the
 oracle, the simulator, and the TPU pipeline so that both backends always agree
 on the protocol parameters.
+
+Archive knobs additionally honor ``SWIRLD_ARCHIVE_*`` environment
+variables so a deployment can retune the background spill pipeline
+without touching code: an explicit ``SwirldConfig`` field wins, then the
+environment variable, then the built-in default (see
+:func:`resolve_archive_settings`).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Dict, Optional, Tuple
+
+#: built-in archive defaults (field -> (env var, default, parser))
+_ARCHIVE_ENV = {
+    "archive_compress_level": ("SWIRLD_ARCHIVE_COMPRESS_LEVEL", 1, int),
+    "archive_queue_depth": ("SWIRLD_ARCHIVE_QUEUE_DEPTH", 8, int),
+    "archive_async": (
+        "SWIRLD_ARCHIVE_ASYNC", True,
+        lambda v: v.strip().lower() not in ("0", "", "no", "false", "off"),
+    ),
+}
+
+
+def resolve_archive_settings(config: Optional["SwirldConfig"] = None) -> Dict:
+    """Concrete archive settings: explicit config field > ``SWIRLD_ARCHIVE_*``
+    env var > built-in default.  Returns ``{"compress_level", "queue_depth",
+    "async_spill"}`` (plain values, never ``None``)."""
+    out = {}
+    names = {
+        "archive_compress_level": "compress_level",
+        "archive_queue_depth": "queue_depth",
+        "archive_async": "async_spill",
+    }
+    for field, (env, default, parse) in _ARCHIVE_ENV.items():
+        v = getattr(config, field, None) if config is not None else None
+        if v is None:
+            raw = os.environ.get(env)
+            v = parse(raw) if raw is not None else default
+        out[names[field]] = v
+    return out
 
 
 @dataclasses.dataclass(frozen=True)
@@ -72,6 +108,20 @@ class SwirldConfig:
     max_reply_events: int = 65536   # server-side cap on events per reply
     quarantine_forkers: bool = False  # detected equivocators trip the
                                       # circuit breaker immediately
+
+    # --- slab archive / background spill pipeline (store.archive) ---
+    # None = fall back to SWIRLD_ARCHIVE_* env var, then built-in default
+    # (resolve_archive_settings).
+    archive_compress_level: Optional[int] = None  # zlib level for spilled
+                                                  # rows (default 1)
+    archive_queue_depth: Optional[int] = None     # bounded spill-queue depth;
+                                                  # a full queue backpressures
+                                                  # the spiller (default 8)
+    archive_async: Optional[bool] = None          # background packing worker
+                                                  # on/off (default on; results
+                                                  # are identical either way —
+                                                  # drain barriers serialize
+                                                  # every read)
 
     def stakes(self) -> Tuple[int, ...]:
         if self.stake is not None:

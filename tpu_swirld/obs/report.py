@@ -95,6 +95,19 @@ def is_resilience_row(g: Dict) -> bool:
     return any(g["name"].startswith(p) for p in _RESILIENCE_PREFIXES)
 
 
+# The slab store / streaming-overlap surface gets its own section: tile
+# budget pressure, archive spill/fetch traffic, the background packing
+# queue, and the compute-vs-wall overlap ratio of the streaming driver.
+_STORE_PREFIXES = (
+    "store_",
+    "stream_overlap",
+)
+
+
+def is_store_row(g: Dict) -> bool:
+    return any(g["name"].startswith(p) for p in _STORE_PREFIXES)
+
+
 def render_report(events: List[Dict]) -> str:
     spans = aggregate_spans(events)
     gauges = gauge_rows(events)
@@ -120,7 +133,14 @@ def render_report(events: List[Dict]) -> str:
     else:
         lines.append("(no spans in trace)")
     resilience = [g for g in gauges if is_resilience_row(g)]
-    protocol = [g for g in gauges if not is_resilience_row(g)]
+    store = [
+        g for g in gauges
+        if is_store_row(g) and not is_resilience_row(g)
+    ]
+    protocol = [
+        g for g in gauges
+        if not is_resilience_row(g) and not is_store_row(g)
+    ]
     lines.append("")
     lines.append("== protocol gauges ==")
     if protocol:
@@ -129,6 +149,12 @@ def render_report(events: List[Dict]) -> str:
             lines.append(f"{_gauge_name(g):<{width}}  {g['value']}")
     else:
         lines.append("(no counter samples in trace)")
+    if store:
+        lines.append("")
+        lines.append("== store (tile budget / archive / spill overlap) ==")
+        width = max(len(_gauge_name(g)) for g in store)
+        for g in store:
+            lines.append(f"{_gauge_name(g):<{width}}  {g['value']}")
     if resilience:
         lines.append("")
         lines.append("== resilience (faults / retries / quarantine) ==")

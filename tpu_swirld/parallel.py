@@ -103,72 +103,95 @@ def ssm_matrix_sharded(sees, member_table, stake, tot_stake, dtype, *, mesh):
     return f(sees, member_table, stake)
 
 
-_mesh_cols_fns = {}
+_mesh_block_fns = {}
 
 
-def make_ssm_cols_fn_for_mesh(mesh: Mesh):
-    """Member-sharded strongly-sees *columns* — the windowed counterpart of
-    :func:`ssm_matrix_sharded`, matching the ``ssm_cols_fn`` seam of
-    :func:`tpu_swirld.tpu.pipeline.ssm_cols_stage` /
+def make_ssm_block_fn_for_mesh(mesh: Mesh):
+    """Member-sharded strongly-sees *block* — the windowed counterpart of
+    :func:`ssm_matrix_sharded`, matching the ``ssm_block_fn`` seam of
+    :func:`tpu_swirld.tpu.pipeline.ssm_block_stage` /
     :class:`~tpu_swirld.tpu.pipeline.IncrementalConsensus`.
 
-    Each device owns M/D members' pre-gathered visibility slabs and
-    computes its members' (N, K) @ (K, C) hops locally; the int32 stake
-    tallies ride one ``lax.psum`` over the member axis.  The member axis
-    is padded to a mesh multiple here (pad slabs are all-invalid and pad
-    stake is 0, so they contribute nothing).
+    Each device owns M/D member-table rows, gathers its members' row/
+    column tiles straight from the (replicated) sees slab, computes the
+    (rows, K) @ (K, C) ∃-z hops locally, and the int32 stake tallies ride
+    one ``lax.psum`` over the member axis.  The member axis is padded to
+    a mesh multiple here (pad rows are all-invalid and pad stake is 0, so
+    they contribute nothing).  The same kernel serves the row-extension
+    pass and the witness-column adds — exactly like the single-device
+    stage, so the mesh driver rides every suffix-cut the host applies.
     """
     d = int(mesh.devices.size)
-    fn = _mesh_cols_fns.get(mesh)
+    fn = _mesh_block_fns.get(mesh)
     if fn is None:
 
         @functools.partial(
-            jax.jit, static_argnames=("tot_stake", "matmul_dtype_name")
+            jax.jit,
+            static_argnames=("rows", "tot_stake", "matmul_dtype_name"),
         )
-        def kernel(a3, b3, stake, cols, *, tot_stake, matmul_dtype_name):
+        def kernel(sees, member_table, stake, cols, row0, *, rows,
+                   tot_stake, matmul_dtype_name):
             dtype = (
                 jnp.bfloat16 if matmul_dtype_name == "bfloat16"
                 else jnp.float32
             )
-            m = a3.shape[0]
+            m = member_table.shape[0]
             m_pad = ((m + d - 1) // d) * d
             if m_pad != m:
-                a3 = jnp.pad(a3, ((0, m_pad - m), (0, 0), (0, 0)))
-                b3 = jnp.pad(b3, ((0, m_pad - m), (0, 0), (0, 0)))
+                member_table = jnp.pad(
+                    member_table, ((0, m_pad - m), (0, 0)),
+                    constant_values=-1,
+                )
                 stake = jnp.pad(stake, ((0, m_pad - m),))
 
             @functools.partial(
                 _shard_map,
                 mesh=mesh,
                 in_specs=(
-                    P(MEMBER_AXIS, None, None),
-                    P(MEMBER_AXIS, None, None),
+                    P(None, None),
+                    P(MEMBER_AXIS, None),
                     P(MEMBER_AXIS),
                     P(None),
+                    P(),
                 ),
                 out_specs=P(None, None),
             )
-            def f(a3l, b3l, stkl, colsl):
-                n = a3l.shape[1]
+            def f(s, mtl, stkl, colsl, row0l):
+                n = s.shape[0]
+                ml, k = mtl.shape
+                idx = mtl.reshape(-1)
+                valid = idx >= 0
+                idxc = jnp.clip(idx, 0, n - 1)
                 colsc = jnp.clip(colsl, 0, n - 1)
                 cv = colsl >= 0
+                s_rows = lax.dynamic_slice(s, (row0l, 0), (rows, n))
+                a_r3 = (
+                    (s_rows[:, idxc] & valid[None, :])
+                    .reshape(rows, ml, k).transpose(1, 0, 2)
+                )
+                b_cols = (
+                    s[idxc[:, None], colsc[None, :]]
+                    & valid[:, None] & cv[None, :]
+                ).reshape(ml, k, colsl.shape[0])
 
                 def body(mm, acc):
-                    b_cols = b3l[mm][:, colsc] & cv[None, :]
-                    hit = _bmm(a3l[mm], b_cols, dtype)
+                    hit = _bmm(a_r3[mm], b_cols[mm], dtype)
                     return acc + stkl[mm] * hit.astype(jnp.int32)
 
-                acc0 = jnp.zeros((n, colsl.shape[0]), dtype=jnp.int32)
+                acc0 = jnp.zeros((rows, colsl.shape[0]), dtype=jnp.int32)
                 if hasattr(lax, "pcast"):
                     acc0 = lax.pcast(acc0, (MEMBER_AXIS,), to="varying")
-                acc = lax.fori_loop(0, a3l.shape[0], body, acc0)
+                acc = lax.fori_loop(0, ml, body, acc0)
                 acc = lax.psum(acc, MEMBER_AXIS)
                 return (3 * acc > 2 * tot_stake) & cv[None, :]
 
-            return f(a3, b3, stake, cols)
+            return f(
+                sees, member_table, stake, cols,
+                jnp.asarray(row0, dtype=jnp.int32),
+            )
 
         fn = kernel
-        _mesh_cols_fns[mesh] = fn
+        _mesh_block_fns[mesh] = fn
     return fn
 
 
@@ -176,16 +199,17 @@ def streaming_consensus_for_mesh(
     mesh: Mesh, members, stake=None, config=None, **kw
 ):
     """A :class:`~tpu_swirld.store.streaming.StreamingConsensus` whose
-    strongly-sees column kernel is sharded over ``mesh`` — tile work
-    (the ``(W, K) @ (K, C)`` member hops over the resident window) runs
-    member-parallel with one ``psum`` stake tally, so the streaming path
-    composes with the mesh exactly like the incremental one."""
+    strongly-sees block kernel is sharded over ``mesh`` — tile work
+    (the ``(rows, K) @ (K, C)`` member hops over the resident window)
+    runs member-parallel with one ``psum`` stake tally, so the streaming
+    path composes with the mesh exactly like the incremental one (and
+    keeps riding the same extension kernels / suffix cuts)."""
     from tpu_swirld.store.streaming import StreamingConsensus
 
-    kernel = make_ssm_cols_fn_for_mesh(mesh)
+    kernel = make_ssm_block_fn_for_mesh(mesh)
     kw.setdefault(
-        "ssm_cols_fn",
-        functools.partial(obs.stage_call, "pipeline.ssm_cols_mesh", kernel),
+        "ssm_block_fn",
+        functools.partial(obs.stage_call, "pipeline.ssm_block_mesh", kernel),
     )
     return StreamingConsensus(members, stake, config, **kw)
 

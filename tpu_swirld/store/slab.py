@@ -1,13 +1,15 @@
 """The fixed tile-budget API over the resident visibility slabs.
 
 The streaming driver's device state is three window slabs — ancestry
-``bool[W, W]``, sees ``bool[W, W]``, strongly-sees columns ``bool[W, C]``
-— plus the per-member gather slabs ``a3 (M, W, K)`` / ``b3 (M, K, W)``.
-:class:`SlabStore` accounts them in ``tile × tile`` tiles, exposes the
-``resident_tiles`` / ``spill`` / ``fetch`` surface the driver consumes,
-and (optionally, ``strict=True``) refuses window growth past
-``budget_tiles``: row capacity, ssm column capacity, member k-slots, and
-widening rebases are all budget-checked before they commit.  The one
+``bool[W, W]``, sees ``bool[W, W]`` (aliasing ancestry, zero extra
+bytes, until the first fork pair), and strongly-sees columns
+``bool[W, C]``; the extension kernels gather per-member rows straight
+from sees, so no separate gather slabs exist.  :class:`SlabStore`
+accounts them in ``tile × tile`` tiles, exposes the ``resident_tiles`` /
+``spill`` / ``fetch`` surface the driver consumes, and (optionally,
+``strict=True``) refuses window growth past ``budget_tiles``: row
+capacity, ssm column capacity, sees materialization, and widening
+rebases are all budget-checked before they commit.  The one
 exempt path is the full-batch rebase fallback (straggler witnesses below
 the frozen vote horizon, late genesis): it allocates batch-scale slabs by
 design and cannot occur for honest traffic; its footprint still lands in
@@ -82,15 +84,22 @@ class SlabStore:
         tile: int = 256,
         strict: bool = False,
         archive: Optional[SlabArchive] = None,
+        config=None,
     ):
         self.tile = int(tile)
         self.budget_tiles = budget_tiles
         self.strict = strict
-        self.archive = archive if archive is not None else SlabArchive()
+        self.archive = (
+            archive if archive is not None else SlabArchive(config=config)
+        )
         self._slabs: Dict[str, _Slab] = {}
         self.budget_overruns = 0
         self.peak_resident_tiles = 0
         self.peak_resident_bytes = 0
+
+    def close(self) -> None:
+        """Flush and stop the archive's background packing worker."""
+        self.archive.close()
 
     # --------------------------------------------------------- accounting
 
@@ -98,6 +107,12 @@ class SlabStore:
         """Register/refresh one resident slab's shape (driver calls this
         whenever a slab is (re)allocated or grown)."""
         self._slabs[name] = _Slab(tuple(int(d) for d in shape), itemsize)
+        self._touch()
+
+    def drop(self, name: str) -> None:
+        """Forget a slab that no longer exists (e.g. ``sees`` while it
+        aliases ``anc`` on a fork-free history)."""
+        self._slabs.pop(name, None)
         self._touch()
 
     @property
@@ -194,6 +209,7 @@ class SlabStore:
     # ------------------------------------------------------------- report
 
     def stats(self) -> Dict:
+        a = self.archive
         return {
             "tile": self.tile,
             "budget_tiles": self.budget_tiles,
@@ -202,10 +218,13 @@ class SlabStore:
             "peak_resident_tiles": self.peak_resident_tiles,
             "peak_resident_bytes": self.peak_resident_bytes,
             "budget_overruns": self.budget_overruns,
-            "archived_rows": self.archive.n_rows,
-            "archive_bytes": self.archive.archive_bytes,
-            "spills": self.archive.spills,
-            "fetches": self.archive.fetches,
-            "spilled_rows": self.archive.spilled_rows,
-            "fetched_rows": self.archive.fetched_rows,
+            "archived_rows": a.n_rows,
+            "archive_bytes": a.archive_bytes,
+            "spills": a.spills,
+            "fetches": a.fetches,
+            "spilled_rows": a.spilled_rows,
+            "fetched_rows": a.fetched_rows,
+            "spill_pack_seconds": round(a.busy_seconds, 4),
+            "spill_stall_seconds": round(a.stall_seconds, 4),
+            "spill_queue_depth_peak": a.max_queue_depth,
         }

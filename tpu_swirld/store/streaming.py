@@ -42,6 +42,7 @@ sees are pure DAG functions, so archived rows equal recomputed rows.
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -54,7 +55,6 @@ from tpu_swirld.store.slab import SlabStore
 from tpu_swirld.tpu.pipeline import (
     IncrementalConsensus,
     _bucket,
-    member_slabs,
 )
 
 
@@ -90,7 +90,10 @@ class StreamingConsensus(IncrementalConsensus):
         self.store = (
             store
             if store is not None
-            else SlabStore(tile_budget, tile=tile, strict=strict_budget)
+            else SlabStore(
+                tile_budget, tile=tile, strict=strict_budget,
+                config=self.config,
+            )
         )
         self._ingest_chunk = _bucket(max(ingest_chunk, 1), self._chunk)
         self._round_hi = 0          # next global round to ledger-retire
@@ -105,33 +108,52 @@ class StreamingConsensus(IncrementalConsensus):
         parent pass.  Commit boundaries never influence outputs (the
         parent's contract), so the split is pure memory hygiene: the
         cold-start rebase and every extension pass stay chunk-sized."""
+        arch = self.store.archive
+        t0 = time.perf_counter()
+        stall0 = arch.stall_seconds
         events = list(events)
         if len(events) <= self._ingest_chunk:
-            return self._finish_stats(super().ingest(events), 1)
-        merged: Optional[Dict] = None
-        n_chunks = 0
-        for s, e in chunk_slices(len(events), self._ingest_chunk):
-            st = super().ingest(events[s:e])
-            n_chunks += 1
-            if merged is None:
-                merged = st
-            else:
-                merged["new_events"] += st["new_events"]
-                merged["ordered"] = merged["ordered"] + st["ordered"]
-                merged["rebased"] = merged["rebased"] or st["rebased"]
-                merged["storm_mode"] = (
-                    merged["storm_mode"] or st["storm_mode"]
-                )
-                merged["seconds"] += st["seconds"]
-                for k in ("window_size", "pruned_prefix"):
-                    merged[k] = st[k]
-        return self._finish_stats(merged, n_chunks)
+            st, n_chunks = super().ingest(events), 1
+        else:
+            merged: Optional[Dict] = None
+            n_chunks = 0
+            for s, e in chunk_slices(len(events), self._ingest_chunk):
+                st = super().ingest(events[s:e])
+                n_chunks += 1
+                if merged is None:
+                    merged = st
+                else:
+                    merged["new_events"] += st["new_events"]
+                    merged["ordered"] = merged["ordered"] + st["ordered"]
+                    merged["rebased"] = merged["rebased"] or st["rebased"]
+                    merged["storm_mode"] = (
+                        merged["storm_mode"] or st["storm_mode"]
+                    )
+                    merged["seconds"] += st["seconds"]
+                    for k in ("window_size", "pruned_prefix"):
+                        merged[k] = st[k]
+            st = merged
+        wall = max(time.perf_counter() - t0, 1e-9)
+        stall = arch.stall_seconds - stall0
+        # overlap ratio: the fraction of the ingest wall during which the
+        # driver was computing rather than blocked behind the spill queue
+        # (1.0 = archival fully off the critical path)
+        overlap = max(0.0, min(1.0, (wall - stall) / wall))
+        return self._finish_stats(st, n_chunks, overlap)
 
-    def _finish_stats(self, st: Dict, n_chunks: int) -> Dict:
+    def _finish_stats(self, st: Dict, n_chunks: int, overlap: float) -> Dict:
         self._account()
+        arch = self.store.archive
         st["ingest_chunks"] = n_chunks
         st["resident_bytes"] = self.resident_visibility_bytes
-        st["archived_rows"] = self.store.archive.n_rows
+        st["archived_rows"] = arch.n_rows
+        st["overlap_ratio"] = round(overlap, 4)
+        st["spill_queue_depth"] = arch.pending_batches
+        o = obs.current()
+        if o is not None:
+            g = o.registry
+            g.gauge("stream_overlap_ratio").set(st["overlap_ratio"])
+            g.gauge("store_spill_queue_depth").set(st["spill_queue_depth"])
         return st
 
     def _account(self) -> None:
@@ -139,10 +161,11 @@ class StreamingConsensus(IncrementalConsensus):
             return
         s = self.store
         s.account("anc", self._anc_d.shape)
-        s.account("sees", self._sees_d.shape)
+        if self._sees_d is not self._anc_d:
+            s.account("sees", self._sees_d.shape)
+        else:
+            s.drop("sees")
         s.account("ssm", self._ssm_d.shape)
-        s.account("a3", self._a3_d.shape)
-        s.account("b3", self._b3_d.shape)
 
     def _ensure_row_capacity(self, need: int) -> None:
         if need > self._w_pad:
@@ -150,16 +173,19 @@ class StreamingConsensus(IncrementalConsensus):
         super()._ensure_row_capacity(need)
 
     def _check_budget(self, w_pad: int) -> bool:
-        k = self._k_cap
-        return self.store.check(
-            {
-                "anc": (w_pad, w_pad),
-                "sees": (w_pad, w_pad),
-                "ssm": (w_pad, self._wcol_cap),
-                "a3": (self._m, w_pad, k),
-                "b3": (self._m, k, w_pad),
-            }
-        )
+        shapes = {
+            "anc": (w_pad, w_pad),
+            "ssm": (w_pad, self._wcol_cap),
+        }
+        if self._initialized and self._sees_d is not self._anc_d:
+            shapes["sees"] = (w_pad, w_pad)
+        return self.store.check(shapes)
+
+    def _materialize_sees(self) -> None:
+        # budget the sees slab coming into existence (first fork pair)
+        self.store.check({"sees": (self._w_pad, self._w_pad)})
+        super()._materialize_sees()
+        self._account()
 
     def _add_columns(self, events) -> None:
         # budget the ssm column-store growth before the parent commits it
@@ -172,17 +198,6 @@ class StreamingConsensus(IncrementalConsensus):
                 )
                 self.store.check({"ssm": (self._w_pad, new_cap)})
         super()._add_columns(events)
-
-    def _grow_k(self, need: int) -> None:
-        # budget the per-member gather-slab growth (k-slot axis)
-        new_k = self._next_k_cap(need)
-        self.store.check(
-            {
-                "a3": (self._m, self._w_pad, new_k),
-                "b3": (self._m, new_k, self._w_pad),
-            }
-        )
-        super()._grow_k(need)
 
     def _stats(self, n_new, ordered, t0, *, rebased,
                count_storm=True, storm=False):
@@ -204,7 +219,10 @@ class StreamingConsensus(IncrementalConsensus):
         lo = self._lo
         if lo + d <= self.store.archive.n_rows:
             return      # re-prune of rows re-admitted by a widening
-        rows = np.asarray(self._anc_d[:d, :w_used])
+        # a lazy device slice, NOT np.asarray: the archive's background
+        # worker pulls + packs it off the critical path (the slice is its
+        # own buffer, so the donated prune roll that follows is safe)
+        rows = self._anc_d[:d, :w_used]
         parents = np.asarray(self.packer.window_view(lo, lo + d)[0])
         self.store.spill(lo, parents, rows)
 
@@ -234,8 +252,8 @@ class StreamingConsensus(IncrementalConsensus):
         lo = self._lo
         if lo > arch.n_rows:
             # slice on device: pull only the newly decided rows, not the
-            # whole bool[N, N] slab
-            rows = np.asarray(aux["anc"][arch.n_rows : lo])
+            # whole bool[N, N] slab (lazy — the pack worker materializes)
+            rows = aux["anc"][arch.n_rows : lo]
             self.store.spill_full(arch.n_rows, rows)
         tabf = out["wit_table"]
         famf = out["famous"].reshape(tabf.shape)
@@ -328,16 +346,20 @@ class StreamingConsensus(IncrementalConsensus):
             _bucket(w2 + 2 * self._chunk, self._window_bucket),
         )
         self._check_budget(new_pad)          # strict mode raises here
+        has_forks = self._fork_np.shape[0] > 0
+        # warm the archive's decompression cache while the device pulls
+        # below drain — the widening's fetch then hits hot rows
+        arch.prefetch(lo2, lo)
         # ---- host pulls of the live window
         anc_cur = np.asarray(self._anc_d)
-        sees_cur = np.asarray(self._sees_d)
+        sees_cur = np.asarray(self._sees_d) if has_forks else anc_cur
         ssm_cur = np.asarray(self._ssm_d)
         # ---- re-fetch archived rows over global columns [lo2, hi)
         creators_g = np.asarray(self.packer.window_view(0, hi)[1])
         fp_g = np.asarray(self.packer.fork_pairs_view(0))
         anc_pre, sees_pre = self.store.fetch(
             lo2, lo, lo2, hi,
-            creator=creators_g[lo2:hi],
+            creator=creators_g[lo2:hi] if has_forks else None,
             fork_pairs=fp_g,
             n_members=self._m,
         )
@@ -362,23 +384,24 @@ class StreamingConsensus(IncrementalConsensus):
         anc_w[delta : delta + w_used, delta : delta + w_used] = (
             anc_cur[:w_used, :w_used]
         )
-        sees_w = np.zeros((new_pad, new_pad), dtype=bool)
-        sees_w[:delta, :w2] = sees_pre
-        sees_w[delta : delta + w_used, delta : delta + w_used] = (
-            sees_cur[:w_used, :w_used]
-        )
-        # fork poisoning of the reconstructed prefix: the one shared
-        # implementation of the rule (pairs with a member outside
-        # [lo2, hi) cannot poison these rows — their second member is
-        # newer than every row here); only the prefix columns are taken,
-        # the retained columns keep the device-computed values
-        from tpu_swirld.store.archive import SlabArchive
+        if has_forks:
+            sees_w = np.zeros((new_pad, new_pad), dtype=bool)
+            sees_w[:delta, :w2] = sees_pre
+            sees_w[delta : delta + w_used, delta : delta + w_used] = (
+                sees_cur[:w_used, :w_used]
+            )
+            # fork poisoning of the reconstructed prefix: the one shared
+            # implementation of the rule (pairs with a member outside
+            # [lo2, hi) cannot poison these rows — their second member is
+            # newer than every row here); only the prefix columns are
+            # taken, the retained columns keep the device-computed values
+            from tpu_swirld.store.archive import SlabArchive
 
-        derived = SlabArchive.derive_sees(
-            anc_w[delta : delta + w_used, :w2], lo2, creators_g[lo2:hi],
-            fp_g, self._m,
-        )
-        sees_w[delta : delta + w_used, :delta] = derived[:, :delta]
+            derived = SlabArchive.derive_sees(
+                anc_w[delta : delta + w_used, :w2], lo2,
+                creators_g[lo2:hi], fp_g, self._m,
+            )
+            sees_w[delta : delta + w_used, :delta] = derived[:, :delta]
         # ---- ssm column store: rows shift down; re-admitted rows are
         # never queried (scans read only scanned rows / witness rows)
         ssm_w = np.zeros((new_pad, self._wcol_cap), dtype=bool)
@@ -396,24 +419,7 @@ class StreamingConsensus(IncrementalConsensus):
         self._wits_w[:w2] = self._wits_g[lo2:hi]
         self._recv_w[:w2] = self._rr_g[lo2:hi] >= 0
         self._recompute_depth(w2)
-        counts = np.bincount(np.asarray(cre2), minlength=self._m)
-        if int(counts.max(initial=0)) > self._k_cap:
-            new_k = self._next_k_cap(int(counts.max()))
-            # the widening k-growth must honor the budget too (the row
-            # check above ran with the stale k)
-            self.store.check(
-                {
-                    "a3": (self._m, new_pad, new_k),
-                    "b3": (self._m, new_k, new_pad),
-                }
-            )
-            self._k_cap = new_k
-        self._mt_np = np.full((self._m, self._k_cap), -1, np.int32)
-        self._mcount = np.zeros((self._m,), np.int32)
-        for i in range(w2):
-            m = int(self._creator_w[i])
-            self._mt_np[m, self._mcount[m]] = i
-            self._mcount[m] += 1
+        self._rebuild_member_table(w2)
         # vetted fork pairs remapped to lo2 (_g_done untouched: the
         # pending delta's pairs are admitted by the extension pass)
         if self._g_done > 0:
@@ -437,14 +443,14 @@ class StreamingConsensus(IncrementalConsensus):
         for pos in range(self._n_cols):
             if ce[pos] >= 0:
                 self._colpos_w[ce[pos]] = pos
-        # ---- push to device, regather member slabs
+        # ---- push to device (sees keeps aliasing anc while fork-free)
+        self._ars_cache = self._ars_key = None
         self._anc_d = jnp.asarray(anc_w)
-        self._sees_d = jnp.asarray(sees_w)
-        self._ssm_d = jnp.asarray(ssm_w)
-        self._a3_d, self._b3_d = obs.stage_call(
-            "pipeline.member_slabs", member_slabs,
-            self._sees_d, jnp.asarray(self._mt_np),
+        self._sees_d = (
+            jnp.asarray(sees_w) if has_forks else self._anc_d
         )
+        self._ssm_d = jnp.asarray(ssm_w)
         self._lo = lo2
+        self._rows_hi = w2
         self._account()
         return True

@@ -22,9 +22,19 @@ HBM on every member iteration; this kernel instead
 HBM traffic: A is read N/Tn times, B N/Tm times, the output written once
 — the int32 tally never touches HBM (the XLA path rewrites it M times).
 
-Correctness is pinned against ``ssm_matrix`` by an interpret-mode parity
-test (``tests/test_pallas.py``); real-TPU timing is pending hardware
-availability (the axon tunnel did not initialize this round).
+Beyond the full-matrix kernel, this module carries the **window-extension
+tile kernels** of the streaming/incremental drivers
+(:func:`make_extension_kernels`): :func:`ssm_block_pallas` (strongly-sees
+rows-×-columns blocks gathered straight from the resident sees slab — the
+``ssm_block_fn`` seam) and :func:`bmm_or_pallas` (the blockwise ancestry
+extension's boolean-matmul hop).  All kernels run bit-identically in
+interpret mode, which is how CPU runs and the parity tests exercise them.
+
+Correctness is pinned against the XLA stages by interpret-mode parity
+tests (``tests/test_pallas.py``), including ragged edge shapes (windows
+not tile-aligned, single-event chunks, post-widen shapes); real-TPU
+timing is pending hardware availability (the axon tunnel did not
+initialize this round).
 """
 
 from __future__ import annotations
@@ -166,38 +176,53 @@ def _fit_tile(t: int, n: int) -> int:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("tot_stake", "matmul_dtype_name", "tile_m", "tile_n",
-                     "interpret"),
+    static_argnames=("rows", "tot_stake", "matmul_dtype_name", "tile_m",
+                     "tile_n", "interpret"),
 )
-def ssm_cols_pallas(a3, b3, stake, cols, *, tot_stake, matmul_dtype_name,
-                    tile_m: int = 256, tile_n: int = 128,
-                    interpret: bool = False):
-    """Strongly-sees *columns* from the pre-gathered member slabs as one
-    Pallas kernel — the windowed counterpart of :func:`ssm_matrix_pallas`,
-    matching the ``ssm_cols_fn`` seam of
-    :func:`tpu_swirld.tpu.pipeline.ssm_cols_stage`.
+def ssm_block_pallas(sees, member_table, stake, cols, row0, *, rows,
+                     tot_stake, matmul_dtype_name,
+                     tile_m: int = 256, tile_n: int = 128,
+                     interpret: bool = False):
+    """Strongly-sees *block* for window rows ``[row0, row0 + rows)`` ×
+    column events ``cols`` as one Pallas kernel — the windowed
+    counterpart of :func:`ssm_matrix_pallas`, matching the
+    ``ssm_block_fn`` seam of :func:`tpu_swirld.tpu.pipeline.
+    ssm_block_stage`.
 
-    The column gather (``b3[:, :, cols]``) happens in XLA; the kernel then
-    walks a ``(N/Tm, C/Tn, M)`` grid with the member axis innermost,
-    accumulating the per-tile stake tally in VMEM scratch exactly as the
-    full-matrix kernel does — the int32 tally never touches HBM.
+    The row/column gathers read **tiles of the sees slab directly** (the
+    one slab the store budgets — no resident per-member gather slabs); the
+    kernel then walks a ``(rows/Tm, C/Tn, M)`` grid with the member axis
+    innermost, accumulating the per-tile stake tally in VMEM scratch
+    exactly as the full-matrix kernel does — the int32 tally never
+    touches HBM.
     """
     matmul_dtype = (
         jnp.bfloat16 if matmul_dtype_name == "bfloat16" else jnp.float32
     )
-    n_members, n, k = a3.shape
+    n = sees.shape[0]
+    n_members, k = member_table.shape
     c = cols.shape[0]
-    tile_m = _fit_tile(tile_m, n)
+    tile_m = _fit_tile(tile_m, rows)
     tile_n = _fit_tile(tile_n, c)
     k_pad = max(128, ((k + 127) // 128) * 128)
+    idx = member_table.reshape(-1)
+    valid = idx >= 0
+    idxc = jnp.clip(idx, 0, n - 1)
     colsc = jnp.clip(cols, 0, n - 1)
     col_valid = cols >= 0
-    a = a3.transpose(1, 0, 2)                                   # N, M, K
-    b_cols = b3[:, :, colsc] & col_valid[None, None, :]         # M, K, C
+    sees_rows = jax.lax.dynamic_slice(sees, (row0, 0), (rows, n))
+    a = (
+        (sees_rows[:, idxc] & valid[None, :])
+        .reshape(rows, n_members, k)
+    )                                                           # rows, M, K
+    b_cols = (
+        sees[idxc[:, None], colsc[None, :]]
+        & valid[:, None] & col_valid[None, :]
+    ).reshape(n_members, k, c)                                  # M, K, C
     if k_pad != k:
         a = jnp.pad(a, ((0, 0), (0, 0), (0, k_pad - k)))
         b_cols = jnp.pad(b_cols, ((0, 0), (0, k_pad - k), (0, 0)))
-    a = a.reshape(n, n_members * k_pad).astype(matmul_dtype)
+    a = a.reshape(rows, n_members * k_pad).astype(matmul_dtype)
     b_cols = b_cols.reshape(n_members * k_pad, c).astype(matmul_dtype)
 
     kernel = functools.partial(
@@ -205,8 +230,8 @@ def ssm_cols_pallas(a3, b3, stake, cols, *, tot_stake, matmul_dtype_name,
     )
     out = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((n, c), jnp.bool_),
-        grid=(n // tile_m, c // tile_n, n_members),
+        out_shape=jax.ShapeDtypeStruct((rows, c), jnp.bool_),
+        grid=(rows // tile_m, c // tile_n, n_members),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),              # stake
             pl.BlockSpec(
@@ -234,17 +259,102 @@ def ssm_cols_pallas(a3, b3, stake, cols, *, tot_stake, matmul_dtype_name,
     return out & col_valid[None, :]
 
 
-def make_ssm_cols_fn(*, interpret: bool = False, tile_m: int = 256,
-                     tile_n: int = 128):
-    """Adapter matching the ``ssm_cols_fn`` seam of the incremental driver
-    (:class:`tpu_swirld.tpu.pipeline.IncrementalConsensus`) and of
-    :func:`tpu_swirld.tpu.pipeline._columns_pass`."""
+def make_ssm_block_fn(*, interpret: bool = False, tile_m: int = 256,
+                      tile_n: int = 128):
+    """Adapter matching the ``ssm_block_fn`` seam of the incremental /
+    streaming drivers (:class:`tpu_swirld.tpu.pipeline.
+    IncrementalConsensus`) and of :func:`tpu_swirld.tpu.pipeline.
+    _columns_pass`."""
 
-    def ssm_cols_fn(a3, b3, stake, cols, *, tot_stake, matmul_dtype_name):
-        return ssm_cols_pallas(
-            a3, b3, stake, cols, tot_stake=tot_stake,
-            matmul_dtype_name=matmul_dtype_name,
+    def ssm_block_fn(sees, member_table, stake, cols, row0, *, rows,
+                     tot_stake, matmul_dtype_name):
+        return ssm_block_pallas(
+            sees, member_table, stake, cols, row0, rows=rows,
+            tot_stake=tot_stake, matmul_dtype_name=matmul_dtype_name,
             tile_m=tile_m, tile_n=tile_n, interpret=interpret,
         )
 
-    return ssm_cols_fn
+    return ssm_block_fn
+
+
+def _bmm_kernel(a_ref, b_ref, out_ref):
+    out_ref[:] = (
+        jnp.dot(a_ref[:], b_ref[:], preferred_element_type=jnp.float32)
+        > 0.5
+    )
+
+
+def bmm_or_pallas(a, b, matmul_dtype, *, tile_m: int = 128,
+                  tile_n: int = 256, interpret: bool = False):
+    """Tiled boolean matmul (OR over 0/1 products) as a Pallas kernel —
+    the MXU hop of the blockwise ancestry extension (``ExtensionKernels.
+    bmm``).  The contraction axis (one event block) rides whole into
+    VMEM; the output grid is ``(P/Tm, R/Tn)``.  Exact: 0/1 products with
+    f32 accumulation, thresholded at 0.5."""
+    p, q = a.shape
+    r = b.shape[1]
+    try:
+        tile_m = _fit_tile(tile_m, p)
+        tile_n = _fit_tile(tile_n, r)
+    except ValueError:
+        # shapes the grid cannot tile — e.g. the forked fused stage's
+        # n_members-wide one-hot hop on a small network — take the plain
+        # XLA matmul (exact either way; only the hot shapes need the MXU)
+        return (
+            jnp.matmul(
+                a.astype(matmul_dtype), b.astype(matmul_dtype),
+                preferred_element_type=jnp.float32,
+            )
+            > 0.5
+        )
+    q_pad = max(128, ((q + 127) // 128) * 128)
+    am = a.astype(matmul_dtype)
+    bm = b.astype(matmul_dtype)
+    if q_pad != q:
+        am = jnp.pad(am, ((0, 0), (0, q_pad - q)))
+        bm = jnp.pad(bm, ((0, q_pad - q), (0, 0)))
+    return pl.pallas_call(
+        _bmm_kernel,
+        out_shape=jax.ShapeDtypeStruct((p, r), jnp.bool_),
+        grid=(p // tile_m, r // tile_n),
+        in_specs=[
+            pl.BlockSpec(
+                (tile_m, q_pad), lambda i, j: (i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (q_pad, tile_n), lambda i, j: (0, j),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (tile_m, tile_n), lambda i, j: (i, j),
+            memory_space=pltpu.VMEM,
+        ),
+        interpret=interpret,
+        compiler_params=_compiler_params(
+            dimension_semantics=("parallel", "parallel"),
+        ),
+    )(am, bm)
+
+
+def make_extension_kernels(*, interpret: bool = False, tile_m: int = 256,
+                           tile_n: int = 128):
+    """The Pallas :class:`~tpu_swirld.tpu.pipeline.ExtensionKernels`
+    bundle for the window-extension hot path: the blockwise ancestry
+    boolean-matmul hop and the strongly-sees block kernel, both consuming
+    sees/ancestry slab tiles directly.  ``interpret=True`` runs the same
+    kernels bit-identically off-TPU (the parity pin of
+    ``tests/test_pallas.py``)."""
+    from tpu_swirld.tpu.pipeline import ExtensionKernels
+
+    def bmm(a, b, dtype):
+        return bmm_or_pallas(a, b, dtype, interpret=interpret)
+
+    return ExtensionKernels(
+        name=f"pallas{'-interpret' if interpret else ''}",
+        bmm=bmm,
+        ssm_block_fn=make_ssm_block_fn(
+            interpret=interpret, tile_m=tile_m, tile_n=tile_n
+        ),
+    )

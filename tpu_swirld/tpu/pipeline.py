@@ -524,31 +524,46 @@ def order_scan(
         cnt_same = jnp.sum(same & fam[None, :], axis=1)  # S: per slot, count of
         ufw = fam & (cnt_same == 1)                      # famous by same creator
         has = ufw.any()
-        anc_rows = anc[we]                               # S,N
-        all_see = (anc_rows | ~ufw[:, None]).all(0)      # N
-        newly = (
-            all_see & ~received & prefix[r] & has & ev_valid
-        )
-        # earliest-seeing timestamps via self-chain walk (w -> genesis)
-        def walk(c2, _):
-            cur, tsw = c2
-            an = anc[cur]                                # S,N
-            tsw = jnp.where(an, t_rank[cur][:, None], tsw)
-            nxt = self_parent[cur]
-            cur = jnp.where(nxt >= 0, nxt, cur)
-            return (cur, tsw), None
 
-        ts0 = jnp.full((s_max, n), INT32_MAX, dtype=jnp.int32)
-        (cur, tsw), _ = lax.scan(walk, (we, ts0), None, length=chain)
-        tsw = jnp.where(ufw[:, None], tsw, INT32_MAX)    # mask non-UFW rows
-        ts_sorted = jnp.sort(tsw, axis=0)                # S,N ascending
-        nv = jnp.sum(ufw)
-        med_i = jnp.clip((nv - 1) // 2, 0, s_max - 1)
-        med = ts_sorted[med_i]                           # N
-        rr_out = jnp.where(newly, r, rr_out)
-        ts_out = jnp.where(newly, med, ts_out)
-        received = received | newly
-        return (received, rr_out, ts_out), None
+        # The ancestry test + chain walk + median are by far the scan's
+        # dominant cost (O(chain * S * N) gathers); rounds that cannot
+        # receive anything — outside the fame-complete prefix, or with no
+        # unique famous witness — skip them entirely.  Exact: ``newly``
+        # was masked by ``prefix[r] & has`` anyway, so the skipped rounds
+        # contributed nothing to the carry.
+        def receive_round(c2):
+            received, rr_out, ts_out = c2
+            anc_rows = anc[we]                           # S,N
+            all_see = (anc_rows | ~ufw[:, None]).all(0)  # N
+            newly = all_see & ~received & ev_valid
+
+            # earliest-seeing timestamps via self-chain walk (w -> genesis)
+            def walk(c3, _):
+                cur, tsw = c3
+                an = anc[cur]                            # S,N
+                tsw = jnp.where(an, t_rank[cur][:, None], tsw)
+                nxt = self_parent[cur]
+                cur = jnp.where(nxt >= 0, nxt, cur)
+                return (cur, tsw), None
+
+            ts0 = jnp.full((s_max, n), INT32_MAX, dtype=jnp.int32)
+            (cur, tsw), _ = lax.scan(walk, (we, ts0), None, length=chain)
+            tsw = jnp.where(ufw[:, None], tsw, INT32_MAX)  # mask non-UFW rows
+            ts_sorted = jnp.sort(tsw, axis=0)            # S,N ascending
+            nv = jnp.sum(ufw)
+            med_i = jnp.clip((nv - 1) // 2, 0, s_max - 1)
+            med = ts_sorted[med_i]                       # N
+            return (
+                received | newly,
+                jnp.where(newly, r, rr_out),
+                jnp.where(newly, med, ts_out),
+            )
+
+        carry = lax.cond(
+            prefix[r] & has, receive_round, lambda c2: c2,
+            (received, rr_out, ts_out),
+        )
+        return carry, None
 
     carry0 = (
         received0 if received0 is not None else jnp.zeros((n,), dtype=bool),
@@ -711,43 +726,143 @@ def visibility_stage(parents, creator, fork_pairs, *, n_members, block,
     return anc, sees
 
 
-@functools.partial(jax.jit, static_argnames=())
-def member_slabs(sees, member_table):
-    """Pre-gathered per-member visibility slabs for the column kernel:
-    A3[m] = "x sees z" for member m's events (N, K) and B3[m] = "z sees w"
-    (K, N) — gathered from the N×N sees matrix exactly once."""
-    n = sees.shape[0]
-    idx = member_table.reshape(-1)
-    valid = idx >= 0
-    idxc = jnp.clip(idx, 0, n - 1)
-    m, k = member_table.shape
-    a3 = (sees[:, idxc] & valid[None, :]).reshape(n, m, k).transpose(1, 0, 2)
-    b3 = (sees[idxc, :] & valid[:, None]).reshape(m, k, n)
-    return a3, b3
+@functools.partial(jax.jit, static_argnames=("block", "matmul_dtype_name"))
+def ancestry_stage(parents, *, block, matmul_dtype_name):
+    """Ancestry only — the fork-free visibility fast path: with no fork
+    pairs packed, ``sees == anc`` (a pair can only exist once its SECOND
+    member is packed, and nothing already packed can descend from it), so
+    the sees slab is an *alias* of the ancestry slab and is neither
+    computed nor stored."""
+    dt = jnp.bfloat16 if matmul_dtype_name == "bfloat16" else jnp.float32
+    return ancestry(parents, block=block, matmul_dtype=dt)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("tot_stake", "matmul_dtype_name")
+    jax.jit,
+    static_argnames=("rows", "tot_stake", "matmul_dtype_name"),
 )
-def ssm_cols_stage(a3, b3, stake, cols, *, tot_stake, matmul_dtype_name):
-    """Strongly-sees columns from pre-gathered slabs: one batched matmul
-    (M, N, K) @ (M, K, C), per-member >0 threshold, int32 stake tally."""
+def ssm_block_stage(sees, member_table, stake, cols, row0, *, rows,
+                    tot_stake, matmul_dtype_name):
+    """Strongly-sees block for window rows ``[row0, row0 + rows)`` against
+    the column events ``cols``, gathered **directly from the sees slab**:
+    per member one (rows, K) @ (K, C) ∃-z hop, int32 stake tally,
+    strict-2/3 threshold.
+
+    This is the single strongly-sees kernel of the windowed drivers — the
+    row-extension pass (new rows × every live column) and the witness-
+    column adds (suffix rows × new columns) are the same computation at
+    different offsets, so one kernel serves both and the old per-member
+    gather slabs (``a3``/``b3``, ~2×M·W·K resident bools) no longer exist:
+    the gathers here read tiles of the one sees slab the store budgets.
+
+    Callers exploit structure to keep ``rows``/``C`` tight: rows *below* a
+    witness column can never strongly-see it (z would need to be both
+    above the row and below the column), so column adds pass only the
+    suffix ``[min(cols), hi)``, and the untouched slab region is already
+    the exact value (zero).
+    """
     dt = jnp.bfloat16 if matmul_dtype_name == "bfloat16" else jnp.float32
-    n = a3.shape[1]
-    n_members = a3.shape[0]
+    n = sees.shape[0]
+    n_members, k = member_table.shape
+    idx = member_table.reshape(-1)
+    valid = idx >= 0
+    idxc = jnp.clip(idx, 0, n - 1)
     colsc = jnp.clip(cols, 0, n - 1)
     col_valid = cols >= 0
-    b_cols = b3[:, :, colsc] & col_valid[None, None, :]      # M,K,C
+    sees_rows = lax.dynamic_slice(sees, (row0, 0), (rows, n))
+    a_r3 = (
+        (sees_rows[:, idxc] & valid[None, :])
+        .reshape(rows, n_members, k).transpose(1, 0, 2)
+    )                                                        # M,rows,K
+    b_cols = (
+        sees[idxc[:, None], colsc[None, :]]
+        & valid[:, None] & col_valid[None, :]
+    ).reshape(n_members, k, cols.shape[0])                   # M,K,C
 
-    def body(m, acc):                     # per-member (N,K)@(K,C) hop; the
-        hit = _bmm(a3[m], b_cols[m], dt)  # (N,C) tally never leaves VMEM/HBM
+    def body(m, acc):                       # per-member hop; the (rows, C)
+        hit = _bmm(a_r3[m], b_cols[m], dt)  # tally never leaves the block
         return acc + stake[m] * hit.astype(jnp.int32)
 
     acc = lax.fori_loop(
         0, n_members, body,
-        jnp.zeros((n, cols.shape[0]), dtype=jnp.int32),
+        jnp.zeros((rows, cols.shape[0]), dtype=jnp.int32),
     )
     return (3 * acc > 2 * tot_stake) & col_valid[None, :]
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def update_block_stage(ssm_c, part, row0, col0):
+    """Write one computed block into the donated column store."""
+    return lax.dynamic_update_slice(ssm_c, part, (row0, col0))
+
+
+@functools.partial(jax.jit, static_argnames=("rows",))
+def ssm_gather_rows_stage(sees, member_table, row0, *, rows):
+    """The a-side gather of :func:`ssm_block_stage` alone: per-member
+    "x sees z" rows for window rows ``[row0, row0 + rows)``.  The sees
+    slab is frozen between a pass's extension and its prune, so the
+    incremental driver gathers this ONCE per pass and reuses it across
+    every witness-column add of the pass (the gather, not the matmul,
+    dominates small column batches)."""
+    n = sees.shape[0]
+    n_members, k = member_table.shape
+    idx = member_table.reshape(-1)
+    valid = idx >= 0
+    idxc = jnp.clip(idx, 0, n - 1)
+    sees_rows = lax.dynamic_slice(sees, (row0, 0), (rows, n))
+    return (
+        (sees_rows[:, idxc] & valid[None, :])
+        .reshape(rows, n_members, k).transpose(1, 0, 2)
+    )                                                        # M,rows,K
+
+
+@functools.partial(
+    jax.jit, static_argnames=("rows", "tot_stake", "matmul_dtype_name")
+)
+def ssm_block_from_rows_stage(a_r3, sees, member_table, stake, cols,
+                              row_off, *, rows, tot_stake,
+                              matmul_dtype_name):
+    """:func:`ssm_block_stage` resumed from a pre-gathered a-side
+    (:func:`ssm_gather_rows_stage`): b-side gather + member hops only,
+    over the cached rows ``[row_off, row_off + rows)`` (the caller's
+    suffix cut — the slice fuses into the member loop, nothing
+    re-materializes)."""
+    dt = jnp.bfloat16 if matmul_dtype_name == "bfloat16" else jnp.float32
+    n = sees.shape[0]
+    n_members, k = member_table.shape
+    idx = member_table.reshape(-1)
+    valid = idx >= 0
+    idxc = jnp.clip(idx, 0, n - 1)
+    colsc = jnp.clip(cols, 0, n - 1)
+    col_valid = cols >= 0
+    b_cols = (
+        sees[idxc[:, None], colsc[None, :]]
+        & valid[:, None] & col_valid[None, :]
+    ).reshape(n_members, k, cols.shape[0])
+
+    def body(m, acc):
+        a_m = lax.dynamic_slice(a_r3[m], (row_off, 0), (rows, k))
+        hit = _bmm(a_m, b_cols[m], dt)
+        return acc + stake[m] * hit.astype(jnp.int32)
+
+    acc = lax.fori_loop(
+        0, n_members, body,
+        jnp.zeros((rows, cols.shape[0]), dtype=jnp.int32),
+    )
+    return (3 * acc > 2 * tot_stake) & col_valid[None, :]
+
+
+def _suffix_rows(row_hi: int, row_lo: int, cap: int):
+    """Pick the static suffix-row count for an ssm block: the smallest
+    power-of-two ≥ 256 covering ``[row_lo, row_hi)``, clamped to ``cap``
+    — a small, session-bounded shape family, so the jit cache stays warm.
+    Returns ``(row0, rows)`` with ``row0 ≤ row_lo``."""
+    need = max(row_hi - row_lo, 1)
+    rows = 256
+    while rows < need:
+        rows *= 2
+    rows = min(rows, cap)
+    return max(0, row_hi - rows), rows
 
 
 @functools.partial(
@@ -1212,7 +1327,7 @@ def _run_consensus_columns(
 def _columns_pass(
     packed, config, parents, creator, t_rank, coin, stake, member_table,
     *, n, tot, block, r_rounds, s_max, chain, matmul_dtype_name,
-    r_cap=None, ssm_cols_fn=None,
+    r_cap=None, ssm_block_fn=None,
 ):
     """Column-restricted strongly-sees execution core.
 
@@ -1224,21 +1339,26 @@ def _columns_pass(
     chunk re-runs (exact, because columns don't depend on rounds).  Every
     query in the final pass over each chunk was answered exactly, so the
     result is bit-identical to the full-matrix scan at Θ(N²·W) cost
-    (W ≈ 10% of N in gossip DAGs).
+    (W ≈ 10% of N in gossip DAGs).  Columns are additionally computed
+    only over their *suffix rows* (a row below a witness can never
+    strongly-see it, and the untouched slab region is already zero — the
+    exact value), which cuts the column work by the witness's depth.
 
     Returns ``(out, aux)``: ``out`` the numpy consensus outputs (for
     :func:`finalize_order`) and ``aux`` the live device intermediates
-    (visibility slabs, member slabs, the column store) that
+    (visibility slabs and the column store) that
     :class:`IncrementalConsensus` lifts into its carried state on a cold
-    start or rebase.  ``ssm_cols_fn`` overrides the strongly-sees column
-    kernel (signature of :func:`ssm_cols_stage`) — the mesh and Pallas
-    backends plug in here.
+    start or rebase.  On a fork-free history ``aux["sees"]`` *is*
+    ``aux["anc"]`` (alias — see :func:`ancestry_stage`).  ``ssm_block_fn``
+    overrides the strongly-sees block kernel (signature of
+    :func:`ssm_block_stage`) — the mesh and Pallas backends plug in here.
     """
     n_pad = parents.shape[0]
     has_forks = bool(len(packed.fork_pairs))
-    if ssm_cols_fn is None:
-        ssm_cols_fn = functools.partial(
-            obs.stage_call, "pipeline.ssm_cols_stage", ssm_cols_stage
+    use_gather_cache = ssm_block_fn is None
+    if ssm_block_fn is None:
+        ssm_block_fn = functools.partial(
+            obs.stage_call, "pipeline.ssm_block_stage", ssm_block_stage
         )
     o = obs.current()
     parents_d = jnp.asarray(parents)
@@ -1246,14 +1366,33 @@ def _columns_pass(
     stake_d = jnp.asarray(stake)
     mt_d = jnp.asarray(member_table)
     n_d = jnp.asarray(n, dtype=jnp.int32)
-    anc, sees = obs.stage_call(
-        "pipeline.visibility_stage",
-        visibility_stage,
-        parents_d, creator_d, jnp.asarray(packed.fork_pairs),
-        n_members=int(stake.shape[0]), block=block,
-        matmul_dtype_name=matmul_dtype_name,
-    )
-    a3, b3 = obs.stage_call("pipeline.member_slabs", member_slabs, sees, mt_d)
+    if has_forks:
+        anc, sees = obs.stage_call(
+            "pipeline.visibility_stage",
+            visibility_stage,
+            parents_d, creator_d, jnp.asarray(packed.fork_pairs),
+            n_members=int(stake.shape[0]), block=block,
+            matmul_dtype_name=matmul_dtype_name,
+        )
+    else:
+        anc = obs.stage_call(
+            "pipeline.visibility_stage", ancestry_stage,
+            parents_d, block=block, matmul_dtype_name=matmul_dtype_name,
+        )
+        sees = anc          # alias: no fork pair packed -> sees == anc
+
+    # the sees slab is frozen for the rest of the pass, so gather the
+    # a-side member rows ONCE and serve every witness-column add from it
+    # (same one-time cost profile as the old precomputed member slabs,
+    # but transient — freed with the pass).  A custom ssm_block_fn
+    # (mesh / Pallas backend) keeps the per-call path: the cache is an
+    # XLA-host optimization, not part of the kernel seam.
+    a_r3_full = None
+    if use_gather_cache:
+        a_r3_full = obs.stage_call(
+            "pipeline.ssm_gather_rows", ssm_gather_rows_stage,
+            sees, mt_d, np.int32(0), rows=n_pad,
+        )
 
     # incremental column store: a preallocated (N, W_CAP) buffer written
     # in place so the scan's input shape stays stable (W_CAP grows in
@@ -1277,13 +1416,25 @@ def _columns_pass(
             ssm_c = jnp.pad(ssm_c, ((0, 0), (0, w_cap - ssm_c.shape[1])))
         cols_arr = np.full((batch,), -1, dtype=np.int32)
         cols_arr[: len(events)] = events
-        part = ssm_cols_fn(
-            a3, b3, stake_d, jnp.asarray(cols_arr), tot_stake=tot,
-            matmul_dtype_name=matmul_dtype_name,
-        )
+        row0, rows_eff = _suffix_rows(n_pad, min(events), n_pad)
+        if a_r3_full is not None:
+            part = obs.stage_call(
+                "pipeline.ssm_block_from_rows", ssm_block_from_rows_stage,
+                a_r3_full, sees, mt_d, stake_d, jnp.asarray(cols_arr),
+                np.int32(row0), rows=rows_eff, tot_stake=tot,
+                matmul_dtype_name=matmul_dtype_name,
+            )
+        else:
+            part = ssm_block_fn(
+                sees, mt_d, stake_d, jnp.asarray(cols_arr), np.int32(row0),
+                rows=rows_eff, tot_stake=tot,
+                matmul_dtype_name=matmul_dtype_name,
+            )
         for j, e in enumerate(events):
             col_pos[e] = n_cols + j
-        ssm_c = lax.dynamic_update_slice(ssm_c, part, (0, n_cols))
+        ssm_c = update_block_stage(
+            ssm_c, part, np.int32(row0), np.int32(n_cols)
+        )
         n_cols += len(events)
 
     add_columns([int(i) for i in np.where(packed.parents[:, 0] < 0)[0]])
@@ -1394,7 +1545,7 @@ def _columns_pass(
     }
     out = jax.tree.map(np.asarray, out)
     aux = {
-        "anc": anc, "sees": sees, "ssm_c": ssm_c, "a3": a3, "b3": b3,
+        "anc": anc, "sees": sees, "ssm_c": ssm_c,
         "col_pos": col_pos, "n_cols": n_cols, "w_cap": w_cap,
         "n_scans": n_scans, "r_rounds": r_rounds, "s_max": s_max,
         "overflow_retries": overflow_retries,
@@ -1486,14 +1637,38 @@ def finalize_order(
 # donated argument so XLA updates it in place where the backend supports
 # donation, and every shape is a session-monotone bucket so the steady
 # loop hits a warm jit cache (no per-pass recompiles).
+#
+# The extension hot path is **pluggable** (:class:`ExtensionKernels`): the
+# blockwise boolean-matmul hop of the ancestry extension and the
+# strongly-sees block kernel can be swapped for Pallas tile kernels
+# (:func:`tpu_swirld.tpu.pallas_kernels.make_extension_kernels`) or the
+# mesh-sharded variant (:func:`tpu_swirld.parallel.make_ssm_block_fn_for_
+# mesh`); the default XLA implementations and the interpret-mode Pallas
+# kernels are bit-identical (0/1 products, f32 accumulation, integer
+# thresholds), pinned by ``tests/test_pallas.py``.
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("block", "matmul_dtype_name"),
-    donate_argnums=(0,),
-)
-def ancestry_extend_stage(anc, parents, b0, b1, *, block, matmul_dtype_name):
+@dataclasses.dataclass(frozen=True)
+class ExtensionKernels:
+    """Kernel bundle for the window-extension hot path.
+
+    ``name`` keys the fused-stage jit cache; ``bmm`` is the boolean-matmul
+    hop ``(a, b, dtype) -> bool`` used by the blockwise ancestry
+    extension (None = the XLA :func:`_bmm`); ``ssm_block_fn`` matches
+    :func:`ssm_block_stage` (None = that stage).
+    """
+
+    name: str
+    bmm: Optional[object] = None
+    ssm_block_fn: Optional[object] = None
+
+
+XLA_EXTENSION_KERNELS = ExtensionKernels(name="xla")
+
+_extend_vis_stages: Dict = {}
+
+
+def _ancestry_extend_body(anc, parents, b0, b1, *, block, dt, bmm):
     """Extend the carried ancestry slab with rows for blocks [b0, b1).
 
     Identical math to :func:`ancestry` resumed over an existing slab:
@@ -1504,7 +1679,6 @@ def ancestry_extend_stage(anc, parents, b0, b1, *, block, matmul_dtype_name):
     pruned parent's ancestry over the retained columns is all-zero (topo
     order: nothing retained is older than a pruned event).
     """
-    dt = jnp.bfloat16 if matmul_dtype_name == "bfloat16" else jnp.float32
     n = parents.shape[0]
     n_sq = max(1, math.ceil(math.log2(block)))
     eye = jnp.eye(block, dtype=bool)
@@ -1517,11 +1691,11 @@ def ancestry_extend_stage(anc, parents, b0, b1, *, block, matmul_dtype_name):
         adj = (local[:, 0:1] == jj[None, :]) | (local[:, 1:2] == jj[None, :])
         lc = adj | eye
         for _ in range(n_sq):
-            lc = lc | _bmm(lc, lc, dt)
+            lc = lc | bmm(lc, lc, dt)
         pc = jnp.clip(pb, 0, n - 1)
         ext = (pb >= 0) & (pb < s)
         g = (r[pc[:, 0]] & ext[:, 0:1]) | (r[pc[:, 1]] & ext[:, 1:2])
-        rows = _bmm(lc, g, dt)
+        rows = bmm(lc, g, dt)
         diag = lax.dynamic_slice(rows, (0, s), (block, block)) | lc
         rows = lax.dynamic_update_slice(rows, diag, (0, s))
         return lax.dynamic_update_slice(r, rows, (s, 0))
@@ -1529,101 +1703,81 @@ def ancestry_extend_stage(anc, parents, b0, b1, *, block, matmul_dtype_name):
     return lax.fori_loop(b0, b1, body, anc)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("n_members", "rows", "matmul_dtype_name"),
-    donate_argnums=(0,),
-)
-def sees_extend_stage(sees, anc, fork_pairs, creator, row0, *, n_members,
-                      rows, matmul_dtype_name):
-    """Write fork-aware sees rows [row0, row0+rows) from the ancestry slab.
+def make_extend_visibility_stage(kern: ExtensionKernels):
+    """Fork-free fused extension: ancestry blocks only (``sees`` aliases
+    ``anc``).  One donated jit dispatch per ingest pass."""
+    fn = _extend_vis_stages.get((kern.name, "noforks"))
+    if fn is None:
+        bmm = kern.bmm or _bmm
 
-    Only new rows are written: an already-present event never changes its
-    visibility (its ancestry is fixed), and old rows over new columns are
-    structurally zero (topo order), so extension is exact.  ``fork_pairs``
-    are window-remapped; the driver rebases whenever a pair member falls
-    below the pruned boundary, so every pair is addressable here.
+        @functools.partial(
+            jax.jit,
+            static_argnames=("block", "matmul_dtype_name"),
+            donate_argnums=(0,),
+        )
+        def extend_visibility_stage(anc, parents, b0, b1, *, block,
+                                    matmul_dtype_name):
+            dt = (
+                jnp.bfloat16 if matmul_dtype_name == "bfloat16"
+                else jnp.float32
+            )
+            return _ancestry_extend_body(
+                anc, parents, b0, b1, block=block, dt=dt, bmm=bmm
+            )
+
+        fn = extend_visibility_stage
+        _extend_vis_stages[(kern.name, "noforks")] = fn
+    return fn
+
+
+def make_extend_visibility_forked_stage(kern: ExtensionKernels):
+    """Forked fused extension: ancestry blocks plus fork-aware sees rows
+    ``[row0, row0 + rows)`` in one donated jit dispatch.
+
+    Only new sees rows are written: an already-present event never changes
+    its visibility (a fork pair only exists once its second member is
+    packed, and nothing older descends from it), and old rows over new
+    columns are structurally zero (topo order), so extension is exact.
+    ``fork_pairs`` are window-remapped; the driver rebases whenever a pair
+    member falls below the pruned boundary, so every pair is addressable.
     """
-    dt = jnp.bfloat16 if matmul_dtype_name == "bfloat16" else jnp.float32
-    n = anc.shape[0]
-    anc_rows = lax.dynamic_slice(anc, (row0, 0), (rows, n))
-    if fork_pairs.shape[0] == 0:
-        fseen = jnp.zeros((rows, n_members), dtype=bool)
-    else:
-        mcol = fork_pairs[:, 0]
-        a = jnp.clip(fork_pairs[:, 1], 0, n - 1)
-        b = jnp.clip(fork_pairs[:, 2], 0, n - 1)
-        hit = anc_rows[:, a] & anc_rows[:, b] & (mcol >= 0)[None, :]
-        onehot = mcol[:, None] == jnp.arange(n_members)[None, :]
-        fseen = _bmm(hit, onehot, dt)
-    new_rows = anc_rows & ~fseen[:, creator]
-    return lax.dynamic_update_slice(sees, new_rows, (row0, 0))
+    fn = _extend_vis_stages.get((kern.name, "forked"))
+    if fn is None:
+        bmm = kern.bmm or _bmm
 
+        @functools.partial(
+            jax.jit,
+            static_argnames=(
+                "block", "rows", "n_members", "matmul_dtype_name"
+            ),
+            donate_argnums=(0, 1),
+        )
+        def extend_visibility_forked_stage(
+            anc, sees, parents, fork_pairs, creator, b0, b1, row0, *,
+            block, rows, n_members, matmul_dtype_name,
+        ):
+            dt = (
+                jnp.bfloat16 if matmul_dtype_name == "bfloat16"
+                else jnp.float32
+            )
+            anc = _ancestry_extend_body(
+                anc, parents, b0, b1, block=block, dt=dt, bmm=bmm
+            )
+            n = anc.shape[0]
+            anc_rows = lax.dynamic_slice(anc, (row0, 0), (rows, n))
+            mcol = fork_pairs[:, 0]
+            a = jnp.clip(fork_pairs[:, 1], 0, n - 1)
+            b = jnp.clip(fork_pairs[:, 2], 0, n - 1)
+            hit = anc_rows[:, a] & anc_rows[:, b] & (mcol >= 0)[None, :]
+            onehot = mcol[:, None] == jnp.arange(n_members)[None, :]
+            fseen = bmm(hit, onehot, dt)
+            new_rows = anc_rows & ~fseen[:, creator]
+            sees = lax.dynamic_update_slice(sees, new_rows, (row0, 0))
+            return anc, sees
 
-@functools.partial(jax.jit, static_argnames=("rows",), donate_argnums=(0, 1))
-def member_slabs_extend_stage(a3, b3, sees, member_table, row0, z_m, z_k,
-                              z_e, *, rows):
-    """Extend the per-member visibility slabs for new events.
-
-    a3 ("x sees z", (M, N, K)) gains the new x rows [row0, row0+rows)
-    gathered over the *updated* member table — old rows never see new z
-    (topo order), so their zero padding is already exact.  b3 ("z sees w",
-    (M, K, N)) gains one scattered row per new event z at its (member,
-    slot) position; old z rows never see new w, so their zero columns are
-    exact too.  Scatter padding rows (z_e == -1) are dropped via
-    out-of-bounds indices.
-    """
-    n = sees.shape[0]
-    m, k = member_table.shape
-    idx = member_table.reshape(-1)
-    valid = idx >= 0
-    idxc = jnp.clip(idx, 0, n - 1)
-    sees_rows = lax.dynamic_slice(sees, (row0, 0), (rows, n))
-    a_rows = (
-        (sees_rows[:, idxc] & valid[None, :])
-        .reshape(rows, m, k).transpose(1, 0, 2)
-    )
-    a3 = lax.dynamic_update_slice(a3, a_rows, (0, row0, 0))
-    zv = z_e >= 0
-    zrows = sees[jnp.clip(z_e, 0, n - 1)] & zv[:, None]
-    # padding rows are routed out of bounds and dropped by the scatter;
-    # clipping them to (0, 0) instead would collide with a genuine write
-    # to member 0 slot 0 (duplicate scatter indices, undefined winner)
-    zm = jnp.where(zv, z_m, m)
-    zk = jnp.where(zv, z_k, k)
-    b3 = b3.at[zm, zk].set(zrows, mode="drop")
-    return a3, b3
-
-
-@functools.partial(
-    jax.jit,
-    static_argnames=("rows", "tot_stake", "matmul_dtype_name"),
-    donate_argnums=(0,),
-)
-def ssm_rows_extend_stage(ssm_c, a3, b3, stake, col_events, row0, *, rows,
-                          tot_stake, matmul_dtype_name):
-    """Strongly-sees values for the new x rows against every existing
-    witness column: per member one (rows, K) @ (K, C) hop, int32 stake
-    tally, strict-2/3 threshold.  Old rows x old columns are untouched
-    (their values never change: new z events are never ancestors of old
-    x), and new columns are filled later by the column kernel."""
-    dt = jnp.bfloat16 if matmul_dtype_name == "bfloat16" else jnp.float32
-    n_members, n, k = a3.shape
-    c = col_events.shape[0]
-    colsc = jnp.clip(col_events, 0, n - 1)
-    col_valid = col_events >= 0
-    b_cols = b3[:, :, colsc] & col_valid[None, None, :]
-
-    def body(m, acc):
-        a_r = lax.dynamic_slice(a3[m], (row0, 0), (rows, k))
-        hit = _bmm(a_r, b_cols[m], dt)
-        return acc + stake[m] * hit.astype(jnp.int32)
-
-    acc = lax.fori_loop(
-        0, n_members, body, jnp.zeros((rows, c), dtype=jnp.int32)
-    )
-    part = (3 * acc > 2 * tot_stake) & col_valid[None, :]
-    return lax.dynamic_update_slice(ssm_c, part, (row0, 0))
+        fn = extend_visibility_forked_stage
+        _extend_vis_stages[(kern.name, "forked")] = fn
+    return fn
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
@@ -1641,6 +1795,37 @@ def prune_stage(anc, sees, ssm_c, d, n_used, keep_cols):
     kc = jnp.clip(keep_cols, 0, ssm_c.shape[1] - 1)
     ssm_c = jnp.roll(ssm_c, -d, axis=0)[:, kc] & live[:, None] & kv[None, :]
     return anc, sees, ssm_c
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def prune_noforks_stage(anc, ssm_c, d, n_used, keep_cols):
+    """:func:`prune_stage` for the fork-free fast path: the sees slab is
+    an alias of ``anc``, so only two slabs roll."""
+    n = anc.shape[0]
+    live = jnp.arange(n) < (n_used - d)
+    m2 = live[:, None] & live[None, :]
+    anc = jnp.roll(jnp.roll(anc, -d, axis=0), -d, axis=1) & m2
+    kv = keep_cols >= 0
+    kc = jnp.clip(keep_cols, 0, ssm_c.shape[1] - 1)
+    ssm_c = jnp.roll(ssm_c, -d, axis=0)[:, kc] & live[:, None] & kv[None, :]
+    return anc, ssm_c
+
+
+@jax.jit
+def _copy_slab_stage(anc):
+    """Materialize a distinct sees slab from the ancestry slab (the
+    fork-free alias ends when the first fork pair arrives)."""
+    return anc | False      # an actual op: forces a fresh buffer
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def compact_cols_stage(ssm_c, keep_cols):
+    """Gather the surviving witness columns without a row shift — the
+    roll-time compaction that keeps retired-round columns from padding
+    every ssm block matmul until the next prune."""
+    kv = keep_cols >= 0
+    kc = jnp.clip(keep_cols, 0, ssm_c.shape[1] - 1)
+    return ssm_c[:, kc] & kv[None, :]
 
 
 @functools.partial(
@@ -1731,7 +1916,8 @@ class IncrementalConsensus:
         window_bucket: int = 1024,
         prune_min: Optional[int] = None,
         matmul_dtype_name: Optional[str] = None,
-        ssm_cols_fn=None,
+        ssm_block_fn=None,
+        extension_kernels: Optional[ExtensionKernels] = None,
         storm_threshold: int = 3,
         storm_cooldown: int = 8,
     ):
@@ -1750,11 +1936,23 @@ class IncrementalConsensus:
                 "float32" if jax.default_backend() == "cpu" else "bfloat16"
             )
         self._mm = matmul_dtype_name
-        if ssm_cols_fn is None:
-            ssm_cols_fn = functools.partial(
-                obs.stage_call, "pipeline.ssm_cols_stage", ssm_cols_stage
+        self._kern = (
+            extension_kernels if extension_kernels is not None
+            else XLA_EXTENSION_KERNELS
+        )
+        # the per-pass a-side gather cache only matches the default XLA
+        # block kernel; a custom seam (mesh / Pallas) owns its own gathers
+        self._cache_blocks = (
+            ssm_block_fn is None and self._kern.ssm_block_fn is None
+        )
+        self._ars_cache = None      # (row0, rows) -> pre-gathered a-side
+        self._ars_key = None
+        if ssm_block_fn is None:
+            base = self._kern.ssm_block_fn or ssm_block_stage
+            ssm_block_fn = functools.partial(
+                obs.stage_call, "pipeline.ssm_block_stage", base
             )
-        self._ssm_cols_fn = ssm_cols_fn
+        self._ssm_block_fn = ssm_block_fn
         self._stake = np.asarray(stake, dtype=np.int32)
         self._tot = int(self._stake.sum())
         self._m = len(members)
@@ -1779,6 +1977,7 @@ class IncrementalConsensus:
 
         # session-monotone static shape buckets (recompile hygiene)
         self._w_pad = 0             # window row capacity
+        self._rows_hi = 0           # high-water of materialized window rows
         self._wcol_cap = 256        # ssm column capacity
         self._r_cap = 32            # witness-table rows
         self._r_fame = 8            # fame round window
@@ -1851,14 +2050,15 @@ class IncrementalConsensus:
     @property
     def resident_visibility_bytes(self) -> int:
         """Bytes of device-resident visibility state (the anc/sees/ssm
-        window slabs plus the per-member gather slabs) — the quantity the
-        slab store's tile budget bounds.  Zero before the first pass."""
+        window slabs; sees aliases anc on a fork-free history and the old
+        per-member gather slabs no longer exist) — the quantity the slab
+        store's tile budget bounds.  Zero before the first pass."""
         if not self._initialized:
             return 0
-        return int(
-            self._anc_d.nbytes + self._sees_d.nbytes + self._ssm_d.nbytes
-            + self._a3_d.nbytes + self._b3_d.nbytes
-        )
+        n = int(self._anc_d.nbytes + self._ssm_d.nbytes)
+        if self._sees_d is not self._anc_d:
+            n += int(self._sees_d.nbytes)
+        return n
 
     # Retirement hooks: no-ops here; :class:`tpu_swirld.store.streaming.
     # StreamingConsensus` overrides them to archive decided rows / rounds
@@ -2043,11 +2243,14 @@ class IncrementalConsensus:
             return
         new_pad = self._next_row_pad(need, self._window_bucket)
         g = new_pad - self._w_pad
+        self._ars_cache = self._ars_key = None
+        aliased = self._sees_d is self._anc_d
         self._anc_d = jnp.pad(self._anc_d, ((0, g), (0, g)))
-        self._sees_d = jnp.pad(self._sees_d, ((0, g), (0, g)))
+        self._sees_d = (
+            self._anc_d if aliased
+            else jnp.pad(self._sees_d, ((0, g), (0, g)))
+        )
         self._ssm_d = jnp.pad(self._ssm_d, ((0, g), (0, 0)))
-        self._a3_d = jnp.pad(self._a3_d, ((0, 0), (0, g), (0, 0)))
-        self._b3_d = jnp.pad(self._b3_d, ((0, 0), (0, 0), (0, g)))
         self._grow_mirrors(new_pad)
         self._w_pad = new_pad
 
@@ -2085,6 +2288,38 @@ class IncrementalConsensus:
         self._mt_np = out
         self._k_cap = new_k
 
+    def _rebuild_member_table(self, w_used: int) -> None:
+        """Vectorized member-table rebuild over window rows [0, w_used):
+        per member, its window events in window (topo) order — identical
+        to the old sequential registration loop, O(w log w) numpy."""
+        cre = self._creator_w[:w_used].astype(np.int64)
+        counts = np.bincount(cre, minlength=self._m)
+        kmax = int(counts.max(initial=0))
+        if kmax > self._k_cap:
+            self._k_cap = self._next_k_cap(kmax)
+        self._mt_np = np.full((self._m, self._k_cap), -1, np.int32)
+        self._mcount = counts.astype(np.int32)
+        if w_used:
+            order = np.argsort(cre, kind="stable")
+            starts = np.concatenate(([0], np.cumsum(counts)[:-1]))
+            kpos = np.arange(w_used) - np.repeat(starts, counts)
+            self._mt_np[cre[order], kpos] = order.astype(np.int32)
+
+    def _materialize_sees(self) -> None:
+        """Fork-free -> forked transition: give sees its own slab.
+
+        Exact without recomputation: the first fork pair's second member
+        is in the *pending* delta (the packer creates a pair when the
+        second member arrives), so no already-present row descends from
+        the pair — every existing row's fseen is all-zero and its sees
+        row equals its ancestry row.  The extension pass then writes the
+        new (possibly poisoned) rows on top of the copy."""
+        if self._initialized and self._sees_d is self._anc_d:
+            self._ars_cache = self._ars_key = None
+            self._sees_d = obs.stage_call(
+                "pipeline.sees_materialize", _copy_slab_stage, self._anc_d
+            )
+
     def _recompute_depth(self, w_used: int) -> None:
         d = self._depth_w
         par = self._parents_w
@@ -2121,16 +2356,43 @@ class IncrementalConsensus:
             self._wcol_cap = new_cap
         cols_arr = np.full((batch,), -1, np.int32)
         cols_arr[: len(events)] = events
-        part = self._ssm_cols_fn(
-            self._a3_d, self._b3_d, jnp.asarray(self._stake),
-            jnp.asarray(cols_arr), tot_stake=self._tot,
-            matmul_dtype_name=self._mm,
-        )
+        # suffix cut: rows below the earliest new witness can never
+        # strongly-see it (the slab already holds their exact value, zero)
+        if (
+            self._cache_blocks
+            and self._ars_cache is not None
+            and min(events) >= self._ars_key[0]
+        ):
+            # pass-local fast path: every new witness is a new row, so the
+            # pass's cached a-side gather already covers the suffix
+            key0, key_rows = self._ars_key
+            off, rows_eff = _suffix_rows(
+                key0 + key_rows, min(events), key_rows
+            )
+            row0 = off
+            part = obs.stage_call(
+                "pipeline.ssm_block_from_rows", ssm_block_from_rows_stage,
+                self._ars_cache, self._sees_d, jnp.asarray(self._mt_np),
+                jnp.asarray(self._stake), jnp.asarray(cols_arr),
+                np.int32(off - key0), rows=rows_eff,
+                tot_stake=self._tot, matmul_dtype_name=self._mm,
+            )
+        else:
+            row0, rows_eff = _suffix_rows(
+                self._rows_hi, min(events), self._w_pad
+            )
+            part = self._ssm_block_fn(
+                self._sees_d, jnp.asarray(self._mt_np),
+                jnp.asarray(self._stake), jnp.asarray(cols_arr),
+                np.int32(row0), rows=rows_eff, tot_stake=self._tot,
+                matmul_dtype_name=self._mm,
+            )
         for j, e in enumerate(events):
             self._colpos_w[e] = self._n_cols + j
             self._col_events[self._n_cols + j] = e
-        self._ssm_d = lax.dynamic_update_slice(
-            self._ssm_d, part, (0, self._n_cols)
+        self._ssm_d = obs.stage_call(
+            "pipeline.inc_ssm_update", update_block_stage,
+            self._ssm_d, part, np.int32(row0), np.int32(self._n_cols),
         )
         self._n_cols += len(events)
 
@@ -2160,74 +2422,84 @@ class IncrementalConsensus:
         dmax = int(self._depth_w[: w0 + n_new].max(initial=1))
         if dmax > self._chain_cap:
             self._chain_cap = _bucket(dmax, 32)
-        # member slots for the new z events
-        regather = False
-        zm = np.full((n_pad_new,), -1, np.int32)
-        zk = np.full((n_pad_new,), -1, np.int32)
-        ze = np.full((n_pad_new,), -1, np.int32)
+        # member-table slots for the new events (host bookkeeping only —
+        # the ssm block kernel gathers straight from the sees slab)
         for j in range(n_new):
             m = int(creator_new[j])
             slot = int(self._mcount[m])
             if slot >= self._k_cap:
                 self._grow_k(slot + 1)
-                regather = True
             self._mt_np[m, slot] = w0 + j
             self._mcount[m] = slot + 1
-            zm[j], zk[j], ze[j] = m, slot, w0 + j
         # fork pairs arriving with this delta (window-remapped)
         if p.n_fork_pairs > self._g_done:
             fp = p.fork_pairs_view(self._g_done)
             new_pairs = np.stack(
                 [fp[:, 0], fp[:, 1] - lo, fp[:, 2] - lo], axis=1,
             ).astype(np.int32)
+            was_forkless = self._fork_np.shape[0] == 0
             self._fork_np = np.concatenate([self._fork_np, new_pairs])
             self._g_done = p.n_fork_pairs
+            if was_forkless:
+                self._materialize_sees()
         has_forks = self._fork_np.shape[0] > 0
 
         parents_d = jnp.asarray(self._parents_w)
         creator_d = jnp.asarray(self._creator_w)
         stake_d = jnp.asarray(self._stake)
-        fork_d = jnp.asarray(self._fork_pairs_padded())
         n_valid = np.int32(w0 + n_new)
 
-        # ---- device: extend ancestry rows, sees rows, member slabs, ssm rows
+        # ---- device: one fused dispatch extends ancestry + sees, then one
+        # ssm block call covers every new row x every live column (the
+        # b-side gather happens once per pass, not once per chunk)
         b0 = w0 // self._block
         b1 = -(-(w0 + n_new) // self._block)
-        self._anc_d = obs.stage_call(
-            "pipeline.inc_ancestry_extend", ancestry_extend_stage,
-            self._anc_d, parents_d, np.int32(b0), np.int32(b1),
-            block=self._block, matmul_dtype_name=self._mm,
-        )
-        for row0 in range(w0, w0 + n_pad_new, chunk):
-            self._sees_d = obs.stage_call(
-                "pipeline.inc_sees_extend", sees_extend_stage,
-                self._sees_d, self._anc_d, fork_d, creator_d,
-                np.int32(row0), n_members=self._m, rows=chunk,
+        if has_forks:
+            self._anc_d, self._sees_d = obs.stage_call(
+                "pipeline.inc_extend_vis",
+                make_extend_visibility_forked_stage(self._kern),
+                self._anc_d, self._sees_d, parents_d,
+                jnp.asarray(self._fork_pairs_padded()), creator_d,
+                np.int32(b0), np.int32(b1), np.int32(w0),
+                block=self._block, rows=n_pad_new, n_members=self._m,
                 matmul_dtype_name=self._mm,
             )
-        mt_d = jnp.asarray(self._mt_np)
-        if regather:
-            self._a3_d, self._b3_d = obs.stage_call(
-                "pipeline.member_slabs", member_slabs, self._sees_d, mt_d
-            )
         else:
-            for row0 in range(w0, w0 + n_pad_new, chunk):
-                j0 = row0 - w0
-                self._a3_d, self._b3_d = obs.stage_call(
-                    "pipeline.inc_member_slabs_extend",
-                    member_slabs_extend_stage,
-                    self._a3_d, self._b3_d, self._sees_d, mt_d,
-                    np.int32(row0), jnp.asarray(zm[j0 : j0 + chunk]),
-                    jnp.asarray(zk[j0 : j0 + chunk]),
-                    jnp.asarray(ze[j0 : j0 + chunk]), rows=chunk,
-                )
-        for row0 in range(w0, w0 + n_pad_new, chunk):
-            self._ssm_d = obs.stage_call(
-                "pipeline.inc_ssm_rows_extend", ssm_rows_extend_stage,
-                self._ssm_d, self._a3_d, self._b3_d, stake_d,
-                jnp.asarray(self._col_events), np.int32(row0), rows=chunk,
+            self._anc_d = obs.stage_call(
+                "pipeline.inc_extend_vis",
+                make_extend_visibility_stage(self._kern),
+                self._anc_d, parents_d, np.int32(b0), np.int32(b1),
+                block=self._block, matmul_dtype_name=self._mm,
+            )
+            self._sees_d = self._anc_d
+        mt_d = jnp.asarray(self._mt_np)
+        c_eff = min(self._wcol_cap, _bucket(max(self._n_cols, 1), 256))
+        cols_d = jnp.asarray(self._col_events[:c_eff])
+        if self._cache_blocks:
+            # gather the new rows' a-side once; the pass's witness-column
+            # adds reuse it (new witnesses are always new rows)
+            self._ars_cache = obs.stage_call(
+                "pipeline.ssm_gather_rows", ssm_gather_rows_stage,
+                self._sees_d, mt_d, np.int32(w0), rows=n_pad_new,
+            )
+            self._ars_key = (w0, n_pad_new)
+            part = obs.stage_call(
+                "pipeline.ssm_block_from_rows", ssm_block_from_rows_stage,
+                self._ars_cache, self._sees_d, mt_d, stake_d, cols_d,
+                np.int32(0), rows=n_pad_new,
                 tot_stake=self._tot, matmul_dtype_name=self._mm,
             )
+        else:
+            part = self._ssm_block_fn(
+                self._sees_d, mt_d, stake_d, cols_d, np.int32(w0),
+                rows=n_pad_new, tot_stake=self._tot,
+                matmul_dtype_name=self._mm,
+            )
+        self._ssm_d = obs.stage_call(
+            "pipeline.inc_ssm_update", update_block_stage,
+            self._ssm_d, part, np.int32(w0), np.int32(0),
+        )
+        self._rows_hi = w0 + n_pad_new
 
         # ---- resumed rounds scan over the new events only
         state = (
@@ -2415,6 +2687,44 @@ class IncrementalConsensus:
         self._famous_np = roll(self._famous_np, -1)
         self._dec_np = roll(self._dec_np, -1)
         self._r_base += dr
+        self._maybe_compact_columns()
+
+    def _live_col_mask(self) -> np.ndarray:
+        """Which occupied column slots are still queryable: witness rounds
+        at or above the committed round window (everything below can never
+        be asked again — the straggler guard rebases first)."""
+        ce = self._col_events[: self._n_cols]
+        valid = ce >= 0
+        return valid & (
+            self._rnd_w[np.clip(ce, 0, self._w_pad - 1)] >= self._r_base
+        )
+
+    def _maybe_compact_columns(self) -> None:
+        """Roll-time column compaction: columns of retired rounds keep
+        padding every ssm block matmul until the next prune; once they
+        outnumber a quarter of the store, gather the live columns left.
+        Prune does the same compaction as part of its row shift."""
+        live = self._live_col_mask()
+        n_live = int(live.sum())
+        stale = self._n_cols - n_live
+        if stale < 256 or stale * 4 < self._n_cols:
+            return
+        keep = np.full((self._wcol_cap,), -1, np.int32)
+        pos_live = np.where(live)[0]
+        keep[: len(pos_live)] = pos_live
+        kept_events = self._col_events[pos_live]
+        self._ssm_d = obs.stage_call(
+            "pipeline.inc_compact_cols", compact_cols_stage,
+            self._ssm_d, jnp.asarray(keep),
+        )
+        self._colpos_w[:] = -1
+        ce = np.full((self._wcol_cap,), -1, np.int32)
+        ce[: len(kept_events)] = kept_events
+        self._colpos_w[kept_events] = np.arange(
+            len(kept_events), dtype=np.int32
+        )
+        self._col_events = ce
+        self._n_cols = len(kept_events)
 
     # ------------------------------------------------------------- prune
 
@@ -2429,22 +2739,29 @@ class IncrementalConsensus:
         if d < self._prune_min:
             return
         self._on_prune(d, w_used)
-        keep = np.full((self._wcol_cap,), -1, np.int32)
-        kept_events: List[int] = []
-        j = 0
-        for pos in range(self._n_cols):
-            e = int(self._col_events[pos])
-            if e < 0:
-                continue
-            if e >= d and int(self._rnd_w[e]) >= self._r_base:
-                keep[j] = pos
-                kept_events.append(e - d)
-                j += 1
-        self._anc_d, self._sees_d, self._ssm_d = obs.stage_call(
-            "pipeline.inc_prune", prune_stage,
-            self._anc_d, self._sees_d, self._ssm_d,
-            np.int32(d), np.int32(w_used), jnp.asarray(keep),
+        self._ars_cache = self._ars_key = None
+        ce = self._col_events[: self._n_cols]
+        live = (
+            (ce >= d)
+            & (self._rnd_w[np.clip(ce, 0, self._w_pad - 1)] >= self._r_base)
         )
+        pos_live = np.where(live)[0]
+        keep = np.full((self._wcol_cap,), -1, np.int32)
+        keep[: len(pos_live)] = pos_live
+        kept_events = self._col_events[pos_live] - d
+        if self._fork_np.shape[0]:
+            self._anc_d, self._sees_d, self._ssm_d = obs.stage_call(
+                "pipeline.inc_prune", prune_stage,
+                self._anc_d, self._sees_d, self._ssm_d,
+                np.int32(d), np.int32(w_used), jnp.asarray(keep),
+            )
+        else:
+            self._anc_d, self._ssm_d = obs.stage_call(
+                "pipeline.inc_prune", prune_noforks_stage,
+                self._anc_d, self._ssm_d,
+                np.int32(d), np.int32(w_used), jnp.asarray(keep),
+            )
+            self._sees_d = self._anc_d
         # host mirrors
         w2 = w_used - d
         pw = self._parents_w[d:w_used]
@@ -2463,12 +2780,7 @@ class IncrementalConsensus:
         roll1(self._recv_w, False)
         self._recompute_depth(w2)
         # member table + fork pairs + witness table entries shift by d
-        self._mt_np[:] = -1
-        self._mcount[:] = 0
-        for i in range(w2):
-            m = int(self._creator_w[i])
-            self._mt_np[m, self._mcount[m]] = i
-            self._mcount[m] += 1
+        self._rebuild_member_table(w2)
         if self._fork_np.shape[0]:
             self._fork_np = np.stack(
                 [self._fork_np[:, 0], self._fork_np[:, 1] - d,
@@ -2478,18 +2790,15 @@ class IncrementalConsensus:
         self._tab_np = np.where(tv, self._tab_np - d, -1)
         # rebuilt column store positions
         self._colpos_w[:] = -1
-        ce = np.full((self._wcol_cap,), -1, np.int32)
-        for jj, e in enumerate(kept_events):
-            ce[jj] = e
-            self._colpos_w[e] = jj
-        self._col_events = ce
+        ce2 = np.full((self._wcol_cap,), -1, np.int32)
+        ce2[: len(kept_events)] = kept_events
+        self._colpos_w[kept_events] = np.arange(
+            len(kept_events), dtype=np.int32
+        )
+        self._col_events = ce2
         self._n_cols = len(kept_events)
         self._lo += d
-        # per-member slab regather (k-slot positions shifted)
-        self._a3_d, self._b3_d = obs.stage_call(
-            "pipeline.member_slabs", member_slabs,
-            self._sees_d, jnp.asarray(self._mt_np),
-        )
+        self._rows_hi = w2
 
     # ------------------------------------------------------------ rebase
 
@@ -2519,7 +2828,10 @@ class IncrementalConsensus:
             arrays["member_table"],
             n=n, tot=self._tot, block=self._block, r_rounds=r_rounds,
             s_max=self._s_cap, chain=chain, matmul_dtype_name=self._mm,
-            ssm_cols_fn=self._ssm_cols_fn,
+            # default kernel -> None, so the batch pass keeps its own
+            # per-pass a-side gather cache; only a custom backend
+            # (mesh / Pallas) overrides the seam
+            ssm_block_fn=None if self._cache_blocks else self._ssm_block_fn,
         )
         # adopt any self-healed capacities (overflow retries inside the
         # batch pass grow s_max/r_rounds; the carried window table must
@@ -2592,15 +2904,7 @@ class IncrementalConsensus:
         self._recv_w[:w_used] = received[lo:]
         self._recompute_depth(w_used)
         # member table over the window
-        self._mcount = np.zeros((self._m,), np.int32)
-        counts = np.bincount(packed.creator[lo:n], minlength=self._m)
-        if int(counts.max(initial=0)) > self._k_cap:
-            self._k_cap = _bucket(int(counts.max()) + 4, 8)
-        self._mt_np = np.full((self._m, self._k_cap), -1, np.int32)
-        for i in range(w_used):
-            m = int(self._creator_w[i])
-            self._mt_np[m, self._mcount[m]] = i
-            self._mcount[m] += 1
+        self._rebuild_member_table(w_used)
         # fork pairs, window-remapped (all members >= lo by the cap above)
         if packed.fork_pairs.shape[0]:
             fp = packed.fork_pairs.astype(np.int32)
@@ -2642,19 +2946,20 @@ class IncrementalConsensus:
                 self._col_events[j] = e - lo
                 self._colpos_w[e - lo] = j
         self._n_cols = n_cols
-        # visibility slabs, window-sliced
+        # visibility slabs, window-sliced (sees aliases anc while fork-free)
         bat_anc = np.asarray(aux["anc"])
-        bat_sees = np.asarray(aux["sees"])
         anc_w = np.zeros((w_pad, w_pad), bool)
         anc_w[:w_used, :w_used] = bat_anc[lo:n, lo:n]
-        sees_w = np.zeros((w_pad, w_pad), bool)
-        sees_w[:w_used, :w_used] = bat_sees[lo:n, lo:n]
         self._anc_d = jnp.asarray(anc_w)
-        self._sees_d = jnp.asarray(sees_w)
+        if packed.fork_pairs.shape[0]:
+            bat_sees = np.asarray(aux["sees"])
+            sees_w = np.zeros((w_pad, w_pad), bool)
+            sees_w[:w_used, :w_used] = bat_sees[lo:n, lo:n]
+            self._sees_d = jnp.asarray(sees_w)
+        else:
+            self._sees_d = self._anc_d
         self._ssm_d = jnp.asarray(ssm_w)
-        self._a3_d, self._b3_d = obs.stage_call(
-            "pipeline.member_slabs", member_slabs,
-            self._sees_d, jnp.asarray(self._mt_np),
-        )
+        self._rows_hi = w_used
+        self._ars_cache = self._ars_key = None
         self._initialized = True
         return self._order[prev_ordered:]
