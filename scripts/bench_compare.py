@@ -52,6 +52,13 @@ EXTRA_KEYS = [
     ("peak_host_bytes", False),
     ("peak_device_bytes", False),
     ("stream.peak_resident_visibility_bytes", False),
+    # mesh-streaming artifacts (bench.py --stream --mesh D): throughput
+    # and scaling efficiency must not regress, per-device residency and
+    # re-pin churn must not grow
+    ("stream_mesh.evps", True),
+    ("stream_mesh.scaling_efficiency", True),
+    ("stream_mesh.peak_device_tiles", False),
+    ("stream_mesh.repins", False),
 ]
 
 

@@ -79,15 +79,18 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--engine",
-        choices=("incremental", "streaming"),
+        choices=("incremental", "streaming", "streaming-mesh"),
         default="incremental",
         help="windowed device driver for the cross-engine parity section: "
-        "incremental (IncrementalConsensus, default) or streaming "
+        "incremental (IncrementalConsensus, default), streaming "
         "(StreamingConsensus over the slab store — decided rows retire to "
         "the host archive and pruned-history references exercise the "
-        "widening rebase).  The acceptance scenario gains an 'engines' "
-        "verdict section; the storm scenarios replay with the chosen "
-        "driver.",
+        "widening rebase), or streaming-mesh (MeshStreamingConsensus — "
+        "the same replay with the resident window row-sharded over every "
+        "available device; simulate devices with "
+        "XLA_FLAGS=--xla_force_host_platform_device_count=8).  The "
+        "acceptance scenario gains an 'engines' verdict section; the "
+        "storm scenarios replay with the chosen driver.",
     )
     ap.add_argument("--seed", type=int, default=0, help="population seed")
     ap.add_argument("--plan-seed", type=int, default=0, help="fault stream seed")

@@ -1,0 +1,269 @@
+"""Row-sharded mesh streaming: the resident window split over devices.
+
+The contract is threefold.  **Placement**: the ``anc``/``sees``/``ssm``
+slabs must live as genuine ``P(axis, None)`` row shards — (W/D, ·) per
+device, never replicated (the whole point is dividing device memory by
+the mesh) — and the store's per-device tile accounting must track the
+shard, with peaks landing at total/D when the shard divides the tile.
+**Parity**: every output is bit-identical to the single-device streaming
+driver, the batch pass, and the oracle, through every streaming corner —
+widening rebase over archived tiles, forged straggler witnesses below
+the frozen vote horizon (the full-rebase fallback), and fork-pair sees
+materialization — because the halo-exchange kernel computes exactly the
+single-device gathers.  **Budget**: ``device_tile_budget`` bounds the
+widest shard exactly like the global budget (strict mode raises).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_swirld.config import SwirldConfig
+from tpu_swirld.oracle.event import Event
+from tpu_swirld.packing import pack_events, pack_node
+from tpu_swirld.parallel import (
+    MeshStreamingConsensus,
+    make_mesh,
+    make_row_sharded_block_fn,
+    streaming_consensus_for_mesh,
+)
+from tpu_swirld.sim import generate_gossip_dag, make_simulation
+from tpu_swirld.store import StreamingConsensus
+from tpu_swirld.store.slab import TileBudgetExceeded
+from tpu_swirld.tpu.pipeline import run_consensus
+
+from tests.test_incremental import assert_same_result
+from tests.test_pipeline import assert_parity
+
+needs8 = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices"
+)
+
+
+def assert_row_sharded(inc, d):
+    """Every resident slab is a (W/D, ·) row shard on each device — the
+    guard against a spec regression quietly re-replicating the window."""
+    slabs = [("anc", inc._anc_d), ("ssm", inc._ssm_d)]
+    if inc._sees_d is not inc._anc_d:
+        slabs.append(("sees", inc._sees_d))
+    for name, arr in slabs:
+        shards = arr.addressable_shards
+        assert len(shards) == d, f"{name}: {len(shards)} shards, want {d}"
+        assert arr.shape[0] % d == 0, name
+        for s in shards:
+            assert s.data.shape[0] == arr.shape[0] // d, (
+                f"{name} shard rows {s.data.shape[0]} != "
+                f"{arr.shape[0]}//{d} (replicated or wrong axis?)"
+            )
+            assert tuple(s.data.shape[1:]) == tuple(arr.shape[1:]), name
+
+
+def test_mesh_smoke_2dev_row_sharded():
+    """Fast tier-1 guard: a tiny history on a 2-device mesh keeps the
+    slabs row-sharded end-to-end and stays batch-identical."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    members, stake, events, _keys = generate_gossip_dag(6, 300, seed=9)
+    cfg = SwirldConfig(n_members=6)
+    inc = streaming_consensus_for_mesh(
+        make_mesh(2), members, stake, cfg, chunk=64, window_bucket=256,
+        prune_min=64, ingest_chunk=128,
+    )
+    for i in range(0, len(events), 100):
+        st = inc.ingest(events[i : i + 100])
+    assert st["mesh_devices"] == 2 and "mesh_repins" in st
+    assert_row_sharded(inc, 2)
+    s = inc.store.stats()
+    assert s["n_shards"] == 2
+    # tile granularity: a shard never accounts MORE than the whole slab
+    # (strict total/D division is pinned by the 8-device tile test)
+    assert s["device_resident_tiles"] <= s["resident_tiles"]
+    packed = pack_events(events, members, stake)
+    assert_same_result(inc.result(), run_consensus(packed, cfg))
+
+
+@needs8
+def test_mesh_device_tiles_are_total_over_d():
+    """When the row shard divides the tile (W/D a tile multiple), the
+    per-device peak is exactly total/D — the bench's acceptance number."""
+    members, stake, events, _keys = generate_gossip_dag(10, 700, seed=5)
+    cfg = SwirldConfig(n_members=10)
+    inc = streaming_consensus_for_mesh(
+        make_mesh(8), members, stake, cfg, chunk=64, window_bucket=2048,
+        prune_min=1024, ingest_chunk=256, tile=256,
+    )
+    for i in range(0, len(events), 200):
+        inc.ingest(events[i : i + 200])
+    assert_row_sharded(inc, 8)
+    s = inc.store.stats()
+    assert inc._w_pad % (256 * 8) == 0     # shard divides the tile
+    assert s["device_resident_tiles"] * 8 == s["resident_tiles"]
+    assert s["peak_device_tiles"] * 8 == s["peak_resident_tiles"]
+
+
+@needs8
+def test_mesh_streaming_widening_rebase_parity():
+    """A stale-view sync referencing long-pruned history: the mesh driver
+    answers with the widening rebase (archived tiles re-fetched, rows
+    scattered back to their owners through slab_put) and stays
+    bit-identical to the single-device streaming driver and batch."""
+    members, stake, events, keys = generate_gossip_dag(8, 1600, seed=11)
+    cfg = SwirldConfig(n_members=8)
+    kw = dict(chunk=64, window_bucket=256, prune_min=64, ingest_chunk=256)
+    mesh_inc = streaming_consensus_for_mesh(
+        make_mesh(8), members, stake, cfg, **kw
+    )
+    single = StreamingConsensus(members, stake, cfg, **kw)
+    for i in range(0, len(events), 200):
+        mesh_inc.ingest(events[i : i + 200])
+        single.ingest(events[i : i + 200])
+    assert mesh_inc.pruned_prefix > 400
+    pk3, sk3 = keys[3]
+    head3 = [ev for ev in events if ev.c == pk3][-1]
+    old0 = events[100]
+    assert 100 < mesh_inc.pruned_prefix
+    strag = Event(
+        d=b"stale-sync", p=(head3.id, old0.id), t=events[-1].t + 1, c=pk3
+    ).signed(sk3)
+    full_before = mesh_inc.full_rebases
+    mesh_inc.ingest([strag])
+    single.ingest([strag])
+    assert mesh_inc.widen_rebases == 1
+    assert mesh_inc.full_rebases == full_before
+    assert mesh_inc.store.archive.fetched_rows > 0
+    assert_row_sharded(mesh_inc, 8)        # the widened push re-scattered
+    assert_same_result(mesh_inc.result(), single.result())
+    packed = pack_events(events + [strag], members, stake)
+    assert_same_result(mesh_inc.result(), run_consensus(packed, cfg))
+
+
+@needs8
+def test_mesh_streaming_straggler_witness_full_rebase():
+    """A forged straggler WITNESS below the frozen vote horizon routes
+    through the exact full-batch fallback; its slab push rides slab_put,
+    so the rebuilt window comes back sharded and oracle-identical."""
+    from tpu_swirld.sim import make_straggler_event
+
+    sim = make_simulation(5, seed=23)
+    sim.run(260)
+    node = sim.nodes[0]
+    events = [node.hg[e] for e in node.order_added]
+    stake = [node.stake[m] for m in node.members]
+    lag = sim.nodes[-1]
+    strag = make_straggler_event(node, lag.pk, lag.sk, at_round=1)
+    inc = streaming_consensus_for_mesh(
+        make_mesh(8), node.members, stake, node.config,
+        block=64, chunk=32, window_bucket=256, prune_min=64,
+    )
+    for i in range(0, len(events), 50):
+        inc.ingest(events[i : i + 50])
+    inc.ingest([strag])
+    assert inc.full_rebases >= 1
+    assert_row_sharded(inc, 8)
+    packed = pack_events(events + [strag], node.members, stake)
+    assert_same_result(
+        inc.result(), run_consensus(packed, node.config, block=64)
+    )
+
+
+@needs8
+def test_mesh_streaming_forks_materialize_sharded_sees():
+    """Fork pairs through the sharded window: sees detaches from anc as
+    its own row shard, fork poisoning stays exact through the halo
+    kernel, and outputs match single-device streaming and the oracle."""
+    members, stake, events, _keys = generate_gossip_dag(
+        12, 1000, seed=4, n_forkers=4
+    )
+    packed = pack_events(events, members, stake)
+    assert len(packed.fork_pairs) > 0
+    cfg = SwirldConfig(n_members=12)
+    kw = dict(chunk=64, window_bucket=512, prune_min=128, ingest_chunk=256)
+    inc = streaming_consensus_for_mesh(
+        make_mesh(8), members, stake, cfg, **kw
+    )
+    single = StreamingConsensus(members, stake, cfg, **kw)
+    for i in range(0, len(events), 250):
+        inc.ingest(events[i : i + 250])
+        single.ingest(events[i : i + 250])
+    assert inc._sees_d is not inc._anc_d   # forks materialized sees
+    assert_row_sharded(inc, 8)
+    assert_same_result(inc.result(), single.result())
+    assert_same_result(inc.result(), run_consensus(packed, cfg))
+
+
+@needs8
+def test_mesh_window_bucket_rounds_to_mesh_multiple():
+    """Every row capacity must split evenly over the mesh: a bucket that
+    doesn't divide is rounded up, so W_pad % D == 0 always holds."""
+    members, stake, events, _keys = generate_gossip_dag(6, 200, seed=2)
+    cfg = SwirldConfig(n_members=6)
+    inc = streaming_consensus_for_mesh(
+        make_mesh(8), members, stake, cfg, chunk=32, window_bucket=260,
+        prune_min=64, ingest_chunk=128,
+    )
+    assert inc._window_bucket % 8 == 0
+    inc.ingest(events)
+    assert inc._w_pad % 8 == 0
+    assert_row_sharded(inc, 8)
+
+
+@needs8
+def test_mesh_device_tile_budget_strict_raises():
+    """``device_tile_budget`` bounds the widest row shard like the global
+    budget: a growth past it raises in strict mode."""
+    members, stake, events, _keys = generate_gossip_dag(8, 600, seed=7)
+    cfg = SwirldConfig(n_members=8)
+    inc = streaming_consensus_for_mesh(
+        make_mesh(8), members, stake, cfg, chunk=64, window_bucket=256,
+        prune_min=64, ingest_chunk=128,
+        device_tile_budget=1, strict_budget=True,
+    )
+    with pytest.raises(TileBudgetExceeded):
+        for i in range(0, len(events), 100):
+            inc.ingest(events[i : i + 100])
+
+
+@needs8
+def test_row_sharded_block_fn_matches_single_device_stage():
+    """The halo-exchange kernel alone, against the single-device stage on
+    identical inputs (including masked member-table slots and pad
+    columns): bit-for-bit equal."""
+    import jax.numpy as jnp
+
+    from tpu_swirld.tpu.pipeline import ssm_block_stage
+
+    rng = np.random.default_rng(0)
+    n, m, k, c, rows = 512, 6, 8, 64, 128
+    sees = jnp.asarray(rng.random((n, n)) < 0.3)
+    mt = rng.integers(-1, n, size=(m, k)).astype(np.int32)
+    stake = np.ones((m,), np.int32)
+    cols = rng.integers(-1, n, size=(c,)).astype(np.int32)
+    kern = make_row_sharded_block_fn(make_mesh(8))
+    for row0 in (0, 96, n - rows):
+        want = ssm_block_stage(
+            sees, jnp.asarray(mt), jnp.asarray(stake), jnp.asarray(cols),
+            np.int32(row0), rows=rows, tot_stake=int(stake.sum()),
+            matmul_dtype_name="float32",
+        )
+        got = kern(
+            sees, jnp.asarray(mt), jnp.asarray(stake), jnp.asarray(cols),
+            np.int32(row0), rows=rows, tot_stake=int(stake.sum()),
+            matmul_dtype_name="float32",
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+@needs8
+def test_mesh_chaos_engine_parity():
+    """`scripts/chaos_run.py --engine streaming-mesh` rides this path: the
+    chaos harness's cross-engine probe with the row-sharded driver."""
+    from tpu_swirld.chaos import _engines_agree
+    from tpu_swirld.sim import run_with_forkers
+
+    sim = run_with_forkers(n_nodes=6, n_forkers=1, n_turns=180, seed=13)
+    node = sim.nodes[0]
+    out = _engines_agree(node, engine="streaming-mesh")
+    assert out["engine"] == "streaming-mesh"
+    assert out["batch_oracle_parity"] and out["incremental_batch_parity"]
+    assert out["mesh_devices"] == 8
+    assert out["store"]["n_shards"] == 8

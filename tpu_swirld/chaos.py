@@ -408,17 +408,30 @@ def _engines_agree(node, engine: str = "incremental") -> Dict:
     vs a cold batch ``run_consensus`` vs a windowed driver replaying the
     same chunked ingest.  ``engine`` picks the windowed driver:
     ``"incremental"`` (:class:`~tpu_swirld.tpu.pipeline.
-    IncrementalConsensus`) or ``"streaming"`` (:class:`~tpu_swirld.store.
+    IncrementalConsensus`), ``"streaming"`` (:class:`~tpu_swirld.store.
     streaming.StreamingConsensus` — decided rows retire into the slab
     archive and pruned-history references take the widening-rebase path,
-    so chaos traffic exercises spill/fetch too).  Returns comparison
+    so chaos traffic exercises spill/fetch too), or ``"streaming-mesh"``
+    (:class:`~tpu_swirld.parallel.MeshStreamingConsensus` — the same
+    streaming replay with the resident window row-sharded over every
+    available device, so forked chaos histories hit the halo-exchange
+    kernel and sharded widening/fetch paths).  Returns comparison
     booleans (all pure-function replays of the same DAG, so anything but
     bit-equality is a bug)."""
+    import functools
+
     from tpu_swirld.packing import pack_node
     from tpu_swirld.tpu.pipeline import IncrementalConsensus, run_consensus
 
     if engine == "streaming":
         from tpu_swirld.store.streaming import StreamingConsensus as _Driver
+    elif engine == "streaming-mesh":
+        import jax
+
+        from tpu_swirld.parallel import MeshStreamingConsensus, make_mesh
+
+        mesh = make_mesh(min(8, len(jax.devices())))
+        _Driver = functools.partial(MeshStreamingConsensus, mesh)
     elif engine == "incremental":
         _Driver = IncrementalConsensus
     else:
@@ -463,9 +476,12 @@ def _engines_agree(node, engine: str = "incremental") -> Dict:
         "incremental_batch_parity": bool(inc_batch),
         "incremental_rebases": inc.rebases,
     }
-    if engine == "streaming":
+    if engine.startswith("streaming"):
         out["store"] = inc.store.stats()
         out["widen_rebases"] = inc.widen_rebases
+    if engine == "streaming-mesh":
+        out["mesh_devices"] = int(inc.mesh.devices.size)
+        out["mesh_repins"] = inc.repins
     return out
 
 

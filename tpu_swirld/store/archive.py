@@ -349,11 +349,16 @@ class SlabArchive:
             o.registry.counter("store_prefetches_total").inc()
 
     def fetch(
-        self, lo: int, hi: int, col_lo: int, col_hi: int
+        self, lo: int, hi: int, col_lo: int, col_hi: int,
+        out: "np.ndarray" = None,
     ) -> np.ndarray:
         """Re-admit archived ancestry rows ``[lo, hi)`` over columns
         ``[col_lo, col_hi)`` as a dense bool matrix (zero beyond each
-        row's own index — topo order).  Drains the spill queue first."""
+        row's own index — topo order).  Drains the spill queue first.
+        ``out`` decompresses straight into a caller buffer (e.g. the
+        widening rebase's assembled slab, which ``slab_put`` then
+        scatters to the mesh) instead of allocating an intermediate —
+        must be bool, ``(hi - lo, col_hi - col_lo)``, zero-filled."""
         if hi > self.n_rows:
             raise ValueError(
                 f"fetch [{lo}, {hi}) exceeds archived prefix {self.n_rows}"
@@ -365,7 +370,13 @@ class SlabArchive:
             else _NULL_CTX
         )
         with span:
-            out = np.zeros((hi - lo, col_hi - col_lo), dtype=bool)
+            if out is None:
+                out = np.zeros((hi - lo, col_hi - col_lo), dtype=bool)
+            elif out.shape != (hi - lo, col_hi - col_lo):
+                raise ValueError(
+                    f"out shape {out.shape} != "
+                    f"{(hi - lo, col_hi - col_lo)}"
+                )
             for i, e in enumerate(range(lo, hi)):
                 row = self._row_bool(e)
                 a = min(col_hi, e + 1)

@@ -85,6 +85,8 @@ class SlabStore:
         strict: bool = False,
         archive: Optional[SlabArchive] = None,
         config=None,
+        n_shards: int = 1,
+        device_budget_tiles: Optional[int] = None,
     ):
         self.tile = int(tile)
         self.budget_tiles = budget_tiles
@@ -96,6 +98,13 @@ class SlabStore:
         self.budget_overruns = 0
         self.peak_resident_tiles = 0
         self.peak_resident_bytes = 0
+        # mesh placement: the window (row) axis of every slab is split
+        # evenly over ``n_shards`` devices, so the per-device residency is
+        # the tile count of one row shard; ``device_budget_tiles`` bounds
+        # that (strict/counted exactly like the global budget)
+        self.n_shards = max(1, int(n_shards))
+        self.device_budget_tiles = device_budget_tiles
+        self.peak_device_tiles = 0
 
     def close(self) -> None:
         """Flush and stop the archive's background packing worker."""
@@ -123,20 +132,54 @@ class SlabStore:
     def resident_bytes(self) -> int:
         return sum(s.nbytes for s in self._slabs.values())
 
+    def _shard_shape(self, shape: Tuple[int, ...]) -> Tuple[int, ...]:
+        """One device's row shard of a slab: the leading axis split over
+        ``n_shards`` (ceil — the last device may hold a short shard, the
+        budget is written for the widest one)."""
+        if not shape or self.n_shards == 1:
+            return shape
+        return (-(-shape[0] // self.n_shards),) + tuple(shape[1:])
+
+    @property
+    def device_resident_tiles(self) -> int:
+        """Resident tiles on the widest device under the row sharding."""
+        return sum(
+            _tiles(self._shard_shape(s.shape), self.tile)
+            for s in self._slabs.values()
+        )
+
     def check(self, prospective: Dict[str, Tuple[int, ...]]) -> bool:
         """Would the slabs, with ``prospective`` shape overrides, fit the
         budget?  In ``strict`` mode an overflow raises; otherwise it is
         counted and ``False`` returned."""
-        if self.budget_tiles is None:
+        if self.budget_tiles is None and self.device_budget_tiles is None:
             return True
         total = 0
+        dev_total = 0
         for name, slab in self._slabs.items():
             shape = prospective.get(name, slab.shape)
             total += _tiles(shape, self.tile)
+            dev_total += _tiles(self._shard_shape(shape), self.tile)
         for name, shape in prospective.items():
             if name not in self._slabs:
                 total += _tiles(shape, self.tile)
-        if total <= self.budget_tiles:
+                dev_total += _tiles(self._shard_shape(shape), self.tile)
+        over = []
+        if self.budget_tiles is not None and total > self.budget_tiles:
+            over.append(
+                f"resident slabs would need {total} tiles "
+                f"(budget {self.budget_tiles}, tile {self.tile})"
+            )
+        if (
+            self.device_budget_tiles is not None
+            and dev_total > self.device_budget_tiles
+        ):
+            over.append(
+                f"per-device shard would need {dev_total} tiles "
+                f"(device budget {self.device_budget_tiles}, "
+                f"{self.n_shards} shards, tile {self.tile})"
+            )
+        if not over:
             return True
         self.budget_overruns += 1
         o = obs.current()
@@ -144,21 +187,24 @@ class SlabStore:
             o.registry.counter("store_budget_overruns_total").inc()
         if self.strict:
             raise TileBudgetExceeded(
-                f"resident slabs would need {total} tiles "
-                f"(budget {self.budget_tiles}, tile {self.tile}); raise the "
-                "budget or lower the ingest chunk / prune threshold"
+                "; ".join(over) + "; raise the budget or lower the ingest "
+                "chunk / prune threshold"
             )
         return False
 
     def _touch(self) -> None:
         rt, rb = self.resident_tiles, self.resident_bytes
+        dt = self.device_resident_tiles
         self.peak_resident_tiles = max(self.peak_resident_tiles, rt)
         self.peak_resident_bytes = max(self.peak_resident_bytes, rb)
+        self.peak_device_tiles = max(self.peak_device_tiles, dt)
         o = obs.current()
         if o is not None:
             g = o.registry
             g.gauge("store_resident_tiles").set(rt)
             g.gauge("store_resident_bytes").set(rb)
+            if self.n_shards > 1:
+                g.gauge("store_device_resident_tiles").set(dt)
 
     # ------------------------------------------------------ spill / fetch
 
@@ -188,12 +234,14 @@ class SlabStore:
         creator: Optional[np.ndarray] = None,
         fork_pairs: Optional[np.ndarray] = None,
         n_members: int = 0,
+        out: Optional[np.ndarray] = None,
     ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
         """Re-admit archived rows ``[lo, hi)`` over columns ``[col_lo,
         col_hi)``.  Returns ``(anc_rows, sees_rows)``; sees is derived
         when ``creator`` (global creator index per column) is given, else
-        ``None``."""
-        anc = self.archive.fetch(lo, hi, col_lo, col_hi)
+        ``None``.  ``out`` decompresses ancestry straight into a caller
+        buffer (see :meth:`SlabArchive.fetch`)."""
+        anc = self.archive.fetch(lo, hi, col_lo, col_hi, out=out)
         sees = None
         if creator is not None:
             fp = (
@@ -217,6 +265,10 @@ class SlabStore:
             "resident_bytes": self.resident_bytes,
             "peak_resident_tiles": self.peak_resident_tiles,
             "peak_resident_bytes": self.peak_resident_bytes,
+            "n_shards": self.n_shards,
+            "device_budget_tiles": self.device_budget_tiles,
+            "device_resident_tiles": self.device_resident_tiles,
+            "peak_device_tiles": self.peak_device_tiles,
             "budget_overruns": self.budget_overruns,
             "archived_rows": a.n_rows,
             "archive_bytes": a.archive_bytes,

@@ -354,14 +354,18 @@ class StreamingConsensus(IncrementalConsensus):
         anc_cur = np.asarray(self._anc_d)
         sees_cur = np.asarray(self._sees_d) if has_forks else anc_cur
         ssm_cur = np.asarray(self._ssm_d)
-        # ---- re-fetch archived rows over global columns [lo2, hi)
+        # ---- re-fetch archived rows over global columns [lo2, hi),
+        # decompressing straight into the widened slab (anc_pre is a view
+        # of anc_w — no intermediate delta x w2 copy)
         creators_g = np.asarray(self.packer.window_view(0, hi)[1])
         fp_g = np.asarray(self.packer.fork_pairs_view(0))
+        anc_w = np.zeros((new_pad, new_pad), dtype=bool)
         anc_pre, sees_pre = self.store.fetch(
             lo2, lo, lo2, hi,
             creator=creators_g[lo2:hi] if has_forks else None,
             fork_pairs=fp_g,
             n_members=self._m,
+            out=anc_w[:delta, :w2],
         )
         # ---- reconstruct the retained rows' prefix columns [lo2, lo):
         # anc(e) ∩ [lo2, lo) = ∪_parents anc(p) ∩ [lo2, lo) for e >= lo
@@ -377,9 +381,7 @@ class StreamingConsensus(IncrementalConsensus):
                     pb[i] |= anc_pre[p - lo2, :delta]
                 else:
                     pb[i] |= pb[p - lo]
-        # ---- assemble the widened slabs
-        anc_w = np.zeros((new_pad, new_pad), dtype=bool)
-        anc_w[:delta, :w2] = anc_pre
+        # ---- assemble the widened slabs (prefix rows already in place)
         anc_w[delta : delta + w_used, :delta] = pb
         anc_w[delta : delta + w_used, delta : delta + w_used] = (
             anc_cur[:w_used, :w_used]
@@ -443,13 +445,15 @@ class StreamingConsensus(IncrementalConsensus):
         for pos in range(self._n_cols):
             if ce[pos] >= 0:
                 self._colpos_w[ce[pos]] = pos
-        # ---- push to device (sees keeps aliasing anc while fork-free)
+        # ---- push to device (sees keeps aliasing anc while fork-free);
+        # the slab_put seam scatters rows to their owning devices when a
+        # mesh driver installed a sharded placement
         self._ars_cache = self._ars_key = None
-        self._anc_d = jnp.asarray(anc_w)
+        self._anc_d = self._put(anc_w)
         self._sees_d = (
-            jnp.asarray(sees_w) if has_forks else self._anc_d
+            self._put(sees_w) if has_forks else self._anc_d
         )
-        self._ssm_d = jnp.asarray(ssm_w)
+        self._ssm_d = self._put(ssm_w)
         self._lo = lo2
         self._rows_hi = w2
         self._account()

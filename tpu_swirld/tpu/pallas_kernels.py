@@ -338,6 +338,22 @@ def bmm_or_pallas(a, b, matmul_dtype, *, tile_m: int = 128,
     )(am, bm)
 
 
+def make_mesh_row_block_fn(mesh, *, interpret: bool = False):
+    """The row-sharded streaming block kernel
+    (:func:`tpu_swirld.parallel.make_row_sharded_block_fn`) with
+    :func:`bmm_or_pallas` as the shard-local matmul hop: the halo
+    exchange and stake-tally psum stay XLA collectives, while each
+    device's ``(rows, K) @ (K, C)`` member hops ride the MXU tile
+    kernel.  Exact for the same reason the single-device pairing is
+    (0/1 products, f32 accumulation, shared threshold)."""
+    from tpu_swirld.parallel import make_row_sharded_block_fn
+
+    def bmm(a, b, dtype):
+        return bmm_or_pallas(a, b, dtype, interpret=interpret)
+
+    return make_row_sharded_block_fn(mesh, bmm=bmm)
+
+
 def make_extension_kernels(*, interpret: bool = False, tile_m: int = 256,
                            tile_n: int = 128):
     """The Pallas :class:`~tpu_swirld.tpu.pipeline.ExtensionKernels`

@@ -770,23 +770,35 @@ def ssm_block_stage(sees, member_table, stake, cols, row0, *, rows,
     colsc = jnp.clip(cols, 0, n - 1)
     col_valid = cols >= 0
     sees_rows = lax.dynamic_slice(sees, (row0, 0), (rows, n))
-    a_r3 = (
-        (sees_rows[:, idxc] & valid[None, :])
-        .reshape(rows, n_members, k).transpose(1, 0, 2)
-    )                                                        # M,rows,K
-    b_cols = (
+    a_flat = sees_rows[:, idxc] & valid[None, :]             # rows,M*K
+    b_flat = (
         sees[idxc[:, None], colsc[None, :]]
         & valid[:, None] & col_valid[None, :]
-    ).reshape(n_members, k, cols.shape[0])                   # M,K,C
+    )                                                        # M*K,C
+    if k == 1 and tot_stake < (1 << 24):
+        # one member row each: the per-member ∃-z indicator IS the 0/1
+        # product, so the whole stake tally collapses into a single
+        # (rows, M) @ (M, C) matmul with stake folded into the b-side —
+        # exact in f32 while the tally stays below 2^24 (same bound the
+        # fame tally relies on), and it replaces M accumulator sweeps
+        # over the (rows, C) block with one GEMM.
+        acc = jnp.matmul(
+            a_flat.astype(jnp.float32),
+            b_flat.astype(jnp.float32) * stake[:, None].astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        ).astype(jnp.int32)
+    else:
+        a_r3 = a_flat.reshape(rows, n_members, k).transpose(1, 0, 2)
+        b_cols = b_flat.reshape(n_members, k, cols.shape[0])
 
-    def body(m, acc):                       # per-member hop; the (rows, C)
-        hit = _bmm(a_r3[m], b_cols[m], dt)  # tally never leaves the block
-        return acc + stake[m] * hit.astype(jnp.int32)
+        def body(m, acc):                   # per-member hop; the (rows, C)
+            hit = _bmm(a_r3[m], b_cols[m], dt)  # tally stays in the block
+            return acc + stake[m] * hit.astype(jnp.int32)
 
-    acc = lax.fori_loop(
-        0, n_members, body,
-        jnp.zeros((rows, cols.shape[0]), dtype=jnp.int32),
-    )
+        acc = lax.fori_loop(
+            0, n_members, body,
+            jnp.zeros((rows, cols.shape[0]), dtype=jnp.int32),
+        )
     return (3 * acc > 2 * tot_stake) & col_valid[None, :]
 
 
@@ -839,6 +851,19 @@ def ssm_block_from_rows_stage(a_r3, sees, member_table, stake, cols,
         sees[idxc[:, None], colsc[None, :]]
         & valid[:, None] & col_valid[None, :]
     ).reshape(n_members, k, cols.shape[0])
+    if k == 1 and tot_stake < (1 << 24):
+        # fused single-GEMM stake tally (see ssm_block_stage): with one
+        # gathered row per member the ∃-z hop is the 0/1 product itself
+        a2 = lax.dynamic_slice(
+            a_r3, (0, row_off, 0), (n_members, rows, 1)
+        ).reshape(n_members, rows)
+        acc = jnp.matmul(
+            a2.T.astype(jnp.float32),
+            b_cols.reshape(n_members, cols.shape[0]).astype(jnp.float32)
+            * stake[:, None].astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        ).astype(jnp.int32)
+        return (3 * acc > 2 * tot_stake) & col_valid[None, :]
 
     def body(m, acc):
         a_m = lax.dynamic_slice(a_r3[m], (row_off, 0), (rows, k))
@@ -1407,8 +1432,11 @@ def _columns_pass(
     def add_columns(events):
         nonlocal n_cols, ssm_c, w_cap
         # bucket only the matmul batch and the buffer CAPACITY; occupancy
-        # advances by the real count so padding slots are reused
-        batch = _bucket(len(events), 16)
+        # advances by the real count so padding slots are reused.  The
+        # grain is deliberately coarse: every distinct padded width is a
+        # fresh jit signature for the block kernel and the donated update,
+        # and compile time — not matmul width — dominates the column path
+        batch = _bucket(len(events), 64)
         if n_cols + batch > w_cap:
             w_cap = _bucket(
                 max(n_cols + batch, min(w_cap * 2, n_pad)), 256
@@ -1920,6 +1948,7 @@ class IncrementalConsensus:
         extension_kernels: Optional[ExtensionKernels] = None,
         storm_threshold: int = 3,
         storm_cooldown: int = 8,
+        slab_put=None,
     ):
         if stake is None:
             stake = [1] * len(members)
@@ -1953,6 +1982,10 @@ class IncrementalConsensus:
                 obs.stage_call, "pipeline.ssm_block_stage", base
             )
         self._ssm_block_fn = ssm_block_fn
+        # slab placement seam: every from-scratch slab push (rebase,
+        # widening) goes through this, so a mesh driver can scatter the
+        # window rows to their owning devices instead of replicating
+        self._put = slab_put if slab_put is not None else jnp.asarray
         self._stake = np.asarray(stake, dtype=np.int32)
         self._tot = int(self._stake.sum())
         self._m = len(members)
@@ -2026,7 +2059,11 @@ class IncrementalConsensus:
 
     @staticmethod
     def _next_k_cap(need: int) -> int:
-        return _bucket(need + 4, 8)
+        # K is a dimension of every gather/block kernel signature, so it
+        # must step rarely: 25% headroom on a coarse grain keeps the
+        # session to a handful of K values instead of one every 8 events
+        # per member (padding is -1 -> masked, exact)
+        return _bucket(need + need // 4 + 8, 32)
 
     # -------------------------------------------------------- public API
 
@@ -2342,7 +2379,10 @@ class IncrementalConsensus:
     def _add_columns(self, events: List[int]) -> None:
         if not events:
             return
-        batch = _bucket(len(events), 16)
+        # coarse grain for the same reason as the batch path: one padded
+        # width per pass keeps the block kernel + donated update on a
+        # single jit signature (padded cols are -1 -> masked -> exact)
+        batch = _bucket(len(events), 64)
         if self._n_cols + batch > self._wcol_cap:
             new_cap = self._next_col_cap(
                 self._n_cols, batch, self._wcol_cap
@@ -2473,8 +2513,40 @@ class IncrementalConsensus:
             )
             self._sees_d = self._anc_d
         mt_d = jnp.asarray(self._mt_np)
-        c_eff = min(self._wcol_cap, _bucket(max(self._n_cols, 1), 256))
-        cols_d = jnp.asarray(self._col_events[:c_eff])
+        # round-restricted column suffix: a new row i is only ever queried
+        # against witness columns of round >= r0(i) - 1 — the rounds scan
+        # asks for round == r0(i) and fame collects votes from the single
+        # round below the voter — so columns whose witness round sits
+        # entirely below min_i r0(i) - 1 can skip the extension matmul;
+        # their block entries keep the slab value (zero), which no reader
+        # ever queries for these rows.
+        col_lo = 0
+        if self._n_cols and n_new:
+            lb = np.zeros((n_new,), np.int32)
+            rw = self._rnd_w
+            for j in range(n_new):
+                p0, p1 = int(parw[j, 0]), int(parw[j, 1])
+                b = 0
+                if p0 >= 0:
+                    b = int(rw[p0]) if p0 < w0 else int(lb[p0 - w0])
+                if p1 >= 0:
+                    b2 = int(rw[p1]) if p1 < w0 else int(lb[p1 - w0])
+                    if b2 > b:
+                        b = b2
+                lb[j] = b
+            min_lb = int(lb.min())
+            if min_lb > 1:
+                ce = self._col_events[: self._n_cols]
+                qm = rw[np.clip(ce, 0, self._w_pad - 1)] >= min_lb - 1
+                first = int(np.argmax(qm)) if qm.any() else self._n_cols
+                # block-aligned so the shape family stays the one the
+                # un-cut pass would compile anyway
+                col_lo = (first // 256) * 256
+        c_eff = min(
+            self._wcol_cap - col_lo,
+            _bucket(max(self._n_cols - col_lo, 1), 256),
+        )
+        cols_d = jnp.asarray(self._col_events[col_lo : col_lo + c_eff])
         if self._cache_blocks:
             # gather the new rows' a-side once; the pass's witness-column
             # adds reuse it (new witnesses are always new rows)
@@ -2497,7 +2569,7 @@ class IncrementalConsensus:
             )
         self._ssm_d = obs.stage_call(
             "pipeline.inc_ssm_update", update_block_stage,
-            self._ssm_d, part, np.int32(w0), np.int32(0),
+            self._ssm_d, part, np.int32(w0), np.int32(col_lo),
         )
         self._rows_hi = w0 + n_pad_new
 
@@ -2621,6 +2693,10 @@ class IncrementalConsensus:
         if ncomp > k_done:
             if ncomp > self._r_ord:
                 self._r_ord = min(self._r_cap, _bucket(ncomp, 2))
+            # the scan masks rounds past the fame-complete prefix, so its
+            # cost window only needs to reach ncomp — not the historical
+            # high-water mark (which still bounds the bucket family)
+            r_ord_eff = min(self._r_ord, max(2, _bucket(ncomp, 2)))
             ts_unique, t_rank = np.unique(self._t_w, return_inverse=True)
             t_rank = t_rank.astype(np.int32).reshape(self._t_w.shape)
             rr_d, ts_d, recv_d = obs.stage_call(
@@ -2630,7 +2706,7 @@ class IncrementalConsensus:
                 jnp.asarray(t_rank),
                 np.int32(self._max_round - self._r_base),
                 np.int32(n_valid), jnp.asarray(self._recv_w),
-                r_max=self._r_ord, s_max=self._s_cap,
+                r_max=r_ord_eff, s_max=self._s_cap,
                 chain=self._chain_cap,
             )
             rr_np = np.asarray(rr_d)
@@ -2950,15 +3026,15 @@ class IncrementalConsensus:
         bat_anc = np.asarray(aux["anc"])
         anc_w = np.zeros((w_pad, w_pad), bool)
         anc_w[:w_used, :w_used] = bat_anc[lo:n, lo:n]
-        self._anc_d = jnp.asarray(anc_w)
+        self._anc_d = self._put(anc_w)
         if packed.fork_pairs.shape[0]:
             bat_sees = np.asarray(aux["sees"])
             sees_w = np.zeros((w_pad, w_pad), bool)
             sees_w[:w_used, :w_used] = bat_sees[lo:n, lo:n]
-            self._sees_d = jnp.asarray(sees_w)
+            self._sees_d = self._put(sees_w)
         else:
             self._sees_d = self._anc_d
-        self._ssm_d = jnp.asarray(ssm_w)
+        self._ssm_d = self._put(ssm_w)
         self._rows_hi = w_used
         self._ars_cache = self._ars_key = None
         self._initialized = True
