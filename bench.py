@@ -88,6 +88,22 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def lint_stamp():
+    """Invariant-lint status of the tree this bench ran from, stamped
+    into the artifact: bench_compare.py refuses to gate a BENCH_*.json
+    whose tree had findings (a number produced by code that violates the
+    determinism/jit/thread invariants is not comparable)."""
+    try:
+        from tpu_swirld.analysis import lint_paths, lint_summary
+
+        pkg = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tpu_swirld"
+        )
+        return lint_summary(lint_paths([pkg]))
+    except Exception as exc:   # the stamp must never sink a bench run
+        return {"error": repr(exc)}
+
+
 def probe_tpu() -> bool:
     """Can the default (axon/TPU) backend initialize? Probe in a child
     process under a hard timeout so a wedged PJRT init can't hang us.
@@ -309,6 +325,7 @@ def run_default():
     }
     if inc_out is not None:
         out["incremental"] = inc_out
+    out["lint"] = lint_stamp()
     print(json.dumps(out), flush=True)
     mon.close()
     if not parity or (inc_out is not None and not inc_out["parity"]):
@@ -553,6 +570,7 @@ def run_stream(tile_budget, tile, mesh_n=0, device_tile_budget=None):
             "streaming events/sec",
             f"mesh-streaming ({mesh_n} dev) events/sec",
         )
+    out["lint"] = lint_stamp()
     print(json.dumps(out), flush=True)
     mon.close()
     if not parity or not budget_ok or not dev_budget_ok:

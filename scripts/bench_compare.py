@@ -81,6 +81,25 @@ def _get(d: Dict[str, Any], dotted: str) -> Optional[float]:
         return None
 
 
+def lint_gate(new: Dict) -> Optional[str]:
+    """Refuse to gate a candidate produced from a tree with lint
+    findings.  bench.py stamps ``lint`` (``tpu_swirld.analysis``
+    summary) into every artifact; a stamp with findings means the
+    number came from code violating the determinism/jit/thread
+    invariants and is not comparable.  Artifacts predating the stamp
+    (BENCH_r01–r05) pass with a warning — the gate only hardens going
+    forward."""
+    lint = new.get("lint")
+    if lint is None:
+        return None
+    if isinstance(lint, dict) and lint.get("clean"):
+        return None
+    return (
+        f"candidate tree had lint findings ({lint!r}); run "
+        "scripts/lint.sh, fix, and re-bench before gating"
+    )
+
+
 def compare(old: Dict, new: Dict, key: str, threshold: float):
     """Returns (failures, report_lines)."""
     lines = []
@@ -123,6 +142,13 @@ def main(argv=None) -> int:
         old = unwrap(json.load(f))
     with open(args.new) as f:
         new = unwrap(json.load(f))
+    gate = lint_gate(new)
+    if gate is not None:
+        print(f"\nFAIL: {gate}", file=sys.stderr)
+        return 1
+    if new.get("lint") is None:
+        print("note: candidate carries no lint stamp (pre-analysis "
+              "artifact); gating on metrics only", file=sys.stderr)
     failures, lines = compare(old, new, args.key, args.threshold)
     for ln in lines:
         print(ln)

@@ -66,6 +66,85 @@ def build_scenario(args) -> ChaosScenario:
     )
 
 
+def run_scenario(args, ckpt_dir, o) -> dict:
+    """One full scenario run under the ambient Obs ``o``; returns the
+    verdict dict (shared by the main run and --sanitize re-runs)."""
+    if args.scenario == "horizon_storm":
+        return run_horizon_storm(
+            ckpt_dir, seed=args.seed, metrics=Metrics(o.registry),
+            engine=args.engine,
+        )
+    if args.scenario == "overflow_storm":
+        return run_overflow_storm(seed=args.seed)
+    sim = ChaosSimulation(
+        build_scenario(args), ckpt_dir, metrics=Metrics(o.registry),
+    )
+    verdict = sim.run()
+    # cross-engine parity over the chaos-shaped DAG: the most complete
+    # honest node's history replayed through the chosen windowed driver
+    # must match batch and oracle
+    from tpu_swirld.chaos import _engines_agree
+
+    probe = max(sim._live_honest(), key=lambda n: len(n.hg))
+    engines = _engines_agree(probe, engine=args.engine)
+    verdict["engines"] = engines
+    verdict["ok"] = bool(
+        verdict["ok"]
+        and engines["batch_oracle_parity"]
+        and engines["incremental_batch_parity"]
+    )
+    return verdict
+
+
+def _verdict_fingerprint(verdict: dict) -> tuple:
+    """The schedule-stable view of a verdict: the ok bit plus the safety
+    section (fault counters and timings vary run to run and are not
+    determinism claims)."""
+    return (
+        bool(verdict.get("ok")),
+        json.dumps(verdict.get("safety"), sort_keys=True),
+    )
+
+
+def run_sanitized(args, base_verdict: dict) -> dict:
+    """--sanitize: re-run the scenario under N seeded yield-injection
+    schedules (every run must reproduce the base verdict's safety
+    fingerprint) and fuzz the archive worker protocol; the returned
+    section folds into the verdict and its ``ok`` gates the exit code."""
+    from tpu_swirld.analysis import races
+
+    def rerun(i: int) -> tuple:
+        with tempfile.TemporaryDirectory(prefix="chaos-san-") as d:
+            with obs.enabled() as o:
+                return _verdict_fingerprint(run_scenario(args, d, o))
+
+    rep = races.run_schedules(
+        rerun, n_schedules=args.sanitize, seed=args.seed
+    )
+    base = _verdict_fingerprint(base_verdict)
+    stable = bool(
+        rep["deterministic"]
+        and rep["results"]
+        and rep["results"][0] == base
+    )
+    arch = races.run_archive_schedules(
+        n_schedules=max(8, args.sanitize), rows=64, seed=args.seed,
+    )
+    return {
+        "schedules": rep["schedules"],
+        "verdicts_stable": stable,
+        "all_ok": all(r[0] for r in rep["results"]),
+        "archive": {
+            k: arch[k]
+            for k in (
+                "schedules", "digests_identical", "matches_sync", "acyclic",
+            )
+        },
+        "ok": bool(stable and all(r[0] for r in rep["results"])
+                   and arch["ok"]),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument(
@@ -103,6 +182,14 @@ def main(argv=None) -> int:
     ap.add_argument("--reorder", type=float, default=0.1)
     ap.add_argument("--delay", type=float, default=0.05)
     ap.add_argument("--checkpoint-every", type=int, default=40)
+    ap.add_argument(
+        "--sanitize", type=int, nargs="?", const=4, default=0,
+        metavar="N",
+        help="re-run the scenario under N seeded yield-injection "
+        "schedules (race sanitizer) and fuzz the archive worker; folds a "
+        "'sanitizer' section into the verdict and fails it on any "
+        "schedule-dependent outcome (default N=4; multiplies runtime)",
+    )
     ap.add_argument("--out", default="chaos_verdict.json")
     args = ap.parse_args(argv)
 
@@ -120,38 +207,16 @@ def main(argv=None) -> int:
         with obs.enabled() as o:
             # one shared registry: gossip counters, transport fault
             # counters, and pipeline gauges all land in the same trace
-            if args.scenario == "horizon_storm":
-                verdict = run_horizon_storm(
-                    ckpt_dir, seed=args.seed, metrics=Metrics(o.registry),
-                    engine=args.engine,
-                )
-            elif args.scenario == "overflow_storm":
-                verdict = run_overflow_storm(seed=args.seed)
-            else:
-                sim = ChaosSimulation(
-                    build_scenario(args), ckpt_dir,
-                    metrics=Metrics(o.registry),
-                )
-                verdict = sim.run()
-                # cross-engine parity over the chaos-shaped DAG: the most
-                # complete honest node's history replayed through the
-                # chosen windowed driver must match batch and oracle
-                from tpu_swirld.chaos import _engines_agree
-
-                probe = max(sim._live_honest(), key=lambda n: len(n.hg))
-                engines = _engines_agree(probe, engine=args.engine)
-                verdict["engines"] = engines
-                verdict["ok"] = bool(
-                    verdict["ok"]
-                    and engines["batch_oracle_parity"]
-                    and engines["incremental_batch_parity"]
-                )
+            verdict = run_scenario(args, ckpt_dir, o)
         trace_path = os.path.splitext(args.out)[0] + ".trace.jsonl"
         o.save(trace_path)
+    if args.sanitize:
+        verdict["sanitizer"] = run_sanitized(args, verdict)
+        verdict["ok"] = bool(verdict["ok"] and verdict["sanitizer"]["ok"])
     with open(args.out, "w") as f:
         json.dump(verdict, f, indent=2, sort_keys=True)
     for key in ("safety", "liveness", "horizon", "fork_storm", "round_clamp",
-                "engines"):
+                "engines", "sanitizer"):
         if key in verdict:
             print(json.dumps({key: verdict[key]}, sort_keys=True))
     print(f"verdict: {'OK' if verdict['ok'] else 'FAIL'} -> {args.out}")

@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Invariant linter entry point: exits non-zero on any finding.
+# Usage: scripts/lint.sh [paths...]   (default: the tpu_swirld package)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m tpu_swirld.analysis lint "${@:-tpu_swirld}"

@@ -1,0 +1,431 @@
+"""Static-analysis & sanitizer suite (tpu_swirld.analysis).
+
+Three layers, mirroring the subsystem:
+
+- per-rule fixtures: every linter rule catches a minimal bad snippet and
+  passes its fixed twin (plus suppression-comment and scope behavior);
+- the acceptance gates: the package itself lints clean (every future PR
+  inherits this), the jit auditor pins zero steady-state recompiles and
+  zero signature drift at the shape buckets, and the race sanitizer's
+  32-schedule archive fuzz holds digest equality + lock-graph acyclicity;
+- sanitizer sensitivity: a deliberately-seeded lost-update fixture and an
+  opposite-order lock pair must both be *caught*.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from tpu_swirld.analysis import check_source, lint_paths
+from tpu_swirld.analysis import jit_audit, races
+from tpu_swirld.analysis.races import (
+    Injector, LockOrderGraph, TrackedLock, injection, run_archive_schedules,
+    run_schedules,
+)
+
+pytestmark = pytest.mark.analysis
+
+_ROOT = os.path.join(os.path.dirname(__file__), "..")
+_PKG = os.path.join(_ROOT, "tpu_swirld")
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_ROOT, "scripts", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ----------------------------------------------------- per-rule fixtures
+
+
+def test_sw001_unseeded_rng():
+    bad = "import random\nx = random.randrange(10)\n"
+    assert "SW001" in _rules(check_source(bad))
+    fixed = "import random\nrng = random.Random(7)\nx = rng.randrange(10)\n"
+    assert check_source(fixed) == []
+    # unseeded constructors are findings; seeded ones are the fix
+    assert "SW001" in _rules(check_source("r = random.Random()\n"))
+    assert "SW001" in _rules(check_source(
+        "import numpy as np\ng = np.random.default_rng()\n"
+    ))
+    assert check_source(
+        "import numpy as np\ng = np.random.default_rng(3)\n"
+    ) == []
+    assert "SW001" in _rules(check_source(
+        "import numpy as np\nx = np.random.rand(4)\n"
+    ))
+
+
+def test_sw002_unordered_iter_scoped():
+    bad = 's = {b"a", b"b"}\nfor x in s:\n    pass\n'
+    assert "SW002" in _rules(
+        check_source(bad, module_path="oracle/node.py")
+    )
+    # same snippet outside the consensus-critical scope: not a finding
+    assert check_source(bad, module_path="sim.py") == []
+    fixed = 's = {b"a", b"b"}\nfor x in sorted(s):\n    pass\n'
+    assert check_source(fixed, module_path="oracle/node.py") == []
+    # order-insensitive consumers are fine; order-sensitive ones are not
+    assert check_source(
+        "s = set()\nn = len(s)\nm = max(s)\n", module_path="oracle/node.py"
+    ) == []
+    assert "SW002" in _rules(check_source(
+        "s = set()\nl = list(s)\n", module_path="oracle/node.py"
+    ))
+    assert "SW002" in _rules(check_source(
+        "s = set()\nout = []\nout.extend(s)\n",
+        module_path="oracle/node.py",
+    ))
+
+
+def test_sw003_wall_clock_scoped():
+    bad = "import time\nt = time.time()\ntime.sleep(0.1)\n"
+    f = check_source(bad, module_path="transport.py")
+    assert _rules(f).count("SW003") == 2
+    # the obs layer is allowed to read clocks
+    assert check_source(bad, module_path="obs/tracer.py") == []
+    fixed = "ticks = 0\nticks += 1\n"
+    assert check_source(fixed, module_path="transport.py") == []
+
+
+def test_sw004_dtype_discipline():
+    bad = "import numpy as np\nidx = np.arange(10)\n"
+    assert "SW004" in _rules(
+        check_source(bad, module_path="tpu/pipeline.py")
+    )
+    fixed = "import numpy as np\nidx = np.arange(10, dtype=np.int32)\n"
+    assert check_source(fixed, module_path="tpu/pipeline.py") == []
+    assert "SW004" in _rules(check_source(
+        "import numpy as np\nz = np.zeros((2, 2))\n",
+        module_path="store/archive.py",
+    ))
+    # dtype=bool IS np.bool_ (1 byte everywhere) — explicitly allowed
+    assert check_source(
+        "import numpy as np\nz = np.zeros((2, 2), dtype=bool)\n",
+        module_path="store/archive.py",
+    ) == []
+    assert "SW004" in _rules(check_source(
+        "x = y.astype(int)\n", module_path="tpu/pipeline.py"
+    ))
+    assert "SW004" in _rules(check_source(
+        "import numpy as np\nz = np.zeros(4, dtype=int)\n",
+        module_path="parallel.py",
+    ))
+    # out of scope: host-side sim code may use numpy defaults
+    assert check_source(bad, module_path="sim.py") == []
+
+
+_DONATED_STAGE = """\
+import functools
+import jax
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def stage(buf, x):
+    return buf + x
+"""
+
+
+def test_sw005_donation_read_after_donate():
+    bad = _DONATED_STAGE + """
+def user(buf, x):
+    out = stage(buf, x)
+    return buf.sum()
+"""
+    assert "SW005" in _rules(check_source(bad))
+    fixed = _DONATED_STAGE + """
+def user(buf, x):
+    buf = stage(buf, x)
+    return buf.sum()
+"""
+    assert check_source(fixed) == []
+
+
+def test_sw005_donation_through_stage_call():
+    bad = _DONATED_STAGE + """
+from tpu_swirld import obs
+
+def user(self, x):
+    out = obs.stage_call("s", stage, self._anc_d, x)
+    return self._anc_d.sum()
+"""
+    assert "SW005" in _rules(check_source(bad))
+    # the package idiom: rebind in the same statement
+    fixed = _DONATED_STAGE + """
+from tpu_swirld import obs
+
+def user(self, x):
+    self._anc_d = obs.stage_call("s", stage, self._anc_d, x)
+    return self._anc_d.sum()
+"""
+    assert check_source(fixed) == []
+
+
+def test_sw006_worker_guarded_attrs():
+    bad = """\
+import threading
+
+class W:
+    def __init__(self):
+        self.count = 0
+
+    def start(self):
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def _loop(self):
+        self.count += 1
+"""
+    assert "SW006" in _rules(check_source(bad))
+    fixed = bad.replace(
+        "class W:\n",
+        'class W:\n    GUARDED_ATTRS = frozenset({"count"})\n\n',
+    )
+    assert check_source(fixed) == []
+
+
+def test_suppression_comment():
+    bad = (
+        "s = set()\n"
+        "for x in s:   # swirld-lint: disable=SW002\n"
+        "    pass\n"
+    )
+    assert check_source(bad, module_path="oracle/node.py") == []
+    by_name = (
+        "s = set()\n"
+        "for x in s:   # swirld-lint: disable=unordered-iter\n"
+        "    pass\n"
+    )
+    assert check_source(by_name, module_path="oracle/node.py") == []
+
+
+# ------------------------------------------------------ acceptance gates
+
+
+def test_package_lints_clean():
+    """The tier-1 gate from the issue: `python -m tpu_swirld.analysis
+    lint tpu_swirld/` exits 0 on this tree — every future PR inherits
+    the invariant rules."""
+    findings = lint_paths([_PKG])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+@pytest.mark.smoke
+def test_lint_cli_smoke(tmp_path):
+    """The module CLI: exit 0 on the package, exit 1 on a bad file."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_swirld.analysis", "lint", _PKG,
+         "--json"],
+        capture_output=True, text=True, env=env, cwd=_ROOT,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert json.loads(r.stdout)["clean"] is True
+    bad = tmp_path / "tpu_swirld" / "oracle" / "bad.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text("s = set()\nfor x in s:\n    pass\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_swirld.analysis", "lint", str(bad)],
+        capture_output=True, text=True, env=env, cwd=_ROOT,
+    )
+    assert r.returncode == 1
+    assert "SW002" in r.stdout
+
+
+def test_static_jit_audit_clean():
+    assert jit_audit.static_audit(_ROOT) == []
+
+
+def test_static_jit_audit_catches_host_sync(tmp_path):
+    root = tmp_path
+    mod = root / "tpu_swirld" / "tpu" / "pipeline.py"
+    mod.parent.mkdir(parents=True)
+    mod.write_text(
+        "import functools, jax\n"
+        "import numpy as np\n"
+        "@functools.partial(jax.jit)\n"
+        "def stage(x):\n"
+        "    return np.asarray(x).sum()\n"
+    )
+    findings = jit_audit.static_audit(str(root))
+    assert findings and findings[0]["stage"] == "stage"
+
+
+def test_find_drift_unit():
+    same = ("arr", (4, 4), "int32", False)
+    weak = ("arr", (4, 4), "int32", True)
+    other = ("arr", (8, 4), "int32", False)
+    assert jit_audit._find_drift({"s": [(same,), (same,)]}) == []
+    # same shape, weak_type flip -> drift
+    drift = jit_audit._find_drift({"s": [(same,), (weak,)]})
+    assert len(drift) == 1 and drift[0]["stage"] == "s"
+    # different shapes are bucketed, not drift
+    assert jit_audit._find_drift({"s": [(same,), (other,)]}) == []
+
+
+def test_jit_audit_zero_steady_recompiles():
+    """The PR-8 shape buckets hold: the audited steady-state window adds
+    zero jit-cache entries and every stage keeps a drift-free abstract
+    signature (a weak_type flip would recompile at identical shapes)."""
+    r = jit_audit.runtime_audit()
+    assert r["steady_compiles"] == {}, r
+    assert r["signature_drift"] == [], r
+    assert r["ok"] and r["stages_observed"]
+
+
+def test_archive_schedule_fuzz_32():
+    """The acceptance fuzz: 32 seeded schedules of concurrent
+    spill/fetch/checkpoint produce bit-identical digests, match the
+    synchronous reference (the async==sync archive pin), and keep the
+    lock-order graph acyclic."""
+    rep = run_archive_schedules(n_schedules=32)
+    assert rep["schedules"] >= 32
+    assert rep["digests_identical"], rep
+    assert rep["matches_sync"], rep
+    assert rep["acyclic"], rep["cycle"]
+    assert rep["ok"]
+
+
+# -------------------------------------------------- sanitizer sensitivity
+
+
+class RacyCounter:
+    """Deliberate lost-update fixture: the read and the write of
+    ``value`` are separated by a sanitizer yield point, exactly where an
+    unlocked real implementation would have its preemption window."""
+
+    def __init__(self):
+        self.value = 0
+
+    def incr(self):
+        v = self.value
+        races.yield_point("racy.read")
+        self.value = v + 1
+
+
+def test_race_sanitizer_detects_seeded_lost_update():
+    def run(i):
+        c = RacyCounter()
+        gate = threading.Barrier(2)
+
+        def worker():
+            gate.wait()
+            for _ in range(200):
+                c.incr()
+
+        ts = [threading.Thread(target=worker) for _ in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        return c.value
+
+    rep = run_schedules(run, n_schedules=8, seed=1)
+    lost = any(v != 400 for v in rep["results"])
+    assert lost or not rep["deterministic"], (
+        f"sanitizer failed to expose the seeded race: {rep}"
+    )
+
+
+def test_lock_order_graph_detects_cycle():
+    g = LockOrderGraph()
+    a, b = TrackedLock("A", g), TrackedLock("B", g)
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    cyc = g.cycle()
+    assert cyc is not None and set(cyc) >= {"A", "B"}
+    # and a consistent order stays acyclic
+    g2 = LockOrderGraph()
+    a2, b2 = TrackedLock("A", g2), TrackedLock("B", g2)
+    for _ in range(2):
+        with a2:
+            with b2:
+                pass
+    assert g2.cycle() is None
+
+
+def test_injector_is_seeded():
+    """Same seed -> same injection decisions (schedules replay)."""
+    fires = []
+    for _ in range(2):
+        inj = Injector(seed=42)
+        with injection(inj):
+            for i in range(100):
+                races.yield_point(f"t{i}")
+        fires.append(inj.fired)
+    assert fires[0] == fires[1] and inj.points == 100
+
+
+# ------------------------------------------------------- tooling wiring
+
+
+@pytest.mark.smoke
+def test_chaos_run_sanitize_smoke(tmp_path):
+    """scripts/chaos_run.py --sanitize: the verdict gains a sanitizer
+    section whose schedules all reproduced the base safety verdict."""
+    mod = _load_script("chaos_run")
+    out = tmp_path / "verdict.json"
+    rc = mod.main([
+        "--seed", "3", "--plan-seed", "3", "--nodes", "4",
+        "--turns", "120", "--forkers", "0", "--checkpoint-every", "40",
+        "--sanitize", "2", "--out", str(out),
+    ])
+    assert rc == 0
+    v = json.loads(out.read_text())
+    san = v["sanitizer"]
+    assert san["schedules"] == 2
+    assert san["verdicts_stable"] and san["all_ok"]
+    assert san["archive"]["digests_identical"]
+    assert san["archive"]["acyclic"]
+    assert san["ok"] and v["ok"]
+
+
+def test_bench_compare_refuses_dirty_lint(tmp_path):
+    """bench_compare.py: a candidate stamped with lint findings is not
+    gated; a clean stamp and a legacy stamp-less artifact are."""
+    mod = _load_script("bench_compare")
+    old = tmp_path / "old.json"
+    old.write_text(json.dumps({"value": 100.0}))
+
+    dirty = tmp_path / "dirty.json"
+    dirty.write_text(json.dumps({
+        "value": 120.0,
+        "lint": {"findings": 2, "clean": False, "by_rule": {"SW002": 2}},
+    }))
+    assert mod.main([str(old), str(dirty)]) == 1
+
+    clean = tmp_path / "clean.json"
+    clean.write_text(json.dumps({
+        "value": 101.0,
+        "lint": {"findings": 0, "clean": True, "by_rule": {}},
+    }))
+    assert mod.main([str(old), str(clean)]) == 0
+    # pre-stamp artifacts (BENCH_r01..r05) still gate on metrics alone
+    assert mod.main([str(old), str(old)]) == 0
+
+
+def test_bench_lint_stamp_shape():
+    """bench.py's stamp helper emits the summary shape bench_compare
+    gates on, and it is clean on this tree."""
+    sys.path.insert(0, _ROOT)
+    try:
+        import bench
+        stamp = bench.lint_stamp()
+    finally:
+        sys.path.remove(_ROOT)
+    assert stamp == {"findings": 0, "clean": True, "by_rule": {}}

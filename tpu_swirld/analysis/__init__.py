@@ -1,0 +1,51 @@
+"""Static analysis & sanitizers for the consensus core.
+
+Three invariant classes hold in this codebase only by convention, and a
+single unnoticed violation of any of them is a latent consensus-safety or
+performance bug:
+
+- **Determinism** — consensus safety is "decided prefixes bit-identical
+  across nodes".  Unseeded RNG, hash-randomized ``set`` iteration
+  (PYTHONHASHSEED), or a wall-clock read on a consensus path silently
+  breaks it.
+- **Jit discipline** — the batch and streaming throughput numbers depend
+  on zero steady-state recompiles, no host syncs inside stage functions,
+  and correct ``donate_argnums`` use (a donated buffer must never be read
+  again).
+- **Thread safety** — the background archive pack worker (store.archive)
+  shares the spill queue, row cache, and drain barriers with the ingest
+  thread; every shared attribute must be declared and audited.
+
+This package enforces all three mechanically:
+
+- :mod:`tpu_swirld.analysis.lint` — an AST-based invariant linter with
+  project-specific rules (:mod:`tpu_swirld.analysis.rules`), a fix-it
+  message and a suppression syntax per rule.  Runs clean over the package
+  as a tier-1 test, so every future PR inherits the gate.
+- :mod:`tpu_swirld.analysis.jit_audit` — a static + runtime auditor of
+  the jitted stage functions: host-sync calls inside jit bodies,
+  steady-state recompiles (cross-checked against
+  :func:`tpu_swirld.obs.compile_counts`), and abstract-value
+  dtype/weak_type drift between calls of the same stage.
+- :mod:`tpu_swirld.analysis.races` — a schedule-fuzzing race sanitizer:
+  yield-injection points in the archive's queue/worker/barrier code, a
+  lock-order graph (deadlock freedom = acyclicity), and an N-schedule
+  fuzz asserting the archive blob-stream digest is bit-identical under
+  every interleaving (the async==sync pin from the overlapped pipeline,
+  now quantified over randomized schedules).
+
+CLI::
+
+    python -m tpu_swirld.analysis lint tpu_swirld/
+    python -m tpu_swirld.analysis jit-audit
+    python -m tpu_swirld.analysis races --schedules 32
+"""
+
+from tpu_swirld.analysis.lint import (  # noqa: F401
+    Finding,
+    check_source,
+    lint_paths,
+    lint_summary,
+)
+
+__all__ = ["Finding", "check_source", "lint_paths", "lint_summary"]

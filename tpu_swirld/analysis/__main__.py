@@ -1,0 +1,37 @@
+"""CLI dispatcher: ``python -m tpu_swirld.analysis <subcommand>``.
+
+Subcommands::
+
+    lint      [paths...] [--json] [--rules ...] [--list-rules]
+    jit-audit [--static-only] [--members N] [--events N] [--json]
+    races     [--schedules N] [--seed S] [--rows N] [--json]
+
+Each exits non-zero on findings / audit failures / schedule divergence,
+so all three slot directly into CI.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__.strip())
+        return 0 if argv else 2
+    cmd, rest = argv[0], argv[1:]
+    if cmd == "lint":
+        from tpu_swirld.analysis.lint import main as m
+    elif cmd == "jit-audit":
+        from tpu_swirld.analysis.jit_audit import main as m
+    elif cmd == "races":
+        from tpu_swirld.analysis.races import main as m
+    else:
+        print(f"unknown subcommand {cmd!r} (lint | jit-audit | races)")
+        return 2
+    return m(rest)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

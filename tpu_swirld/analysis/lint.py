@@ -1,0 +1,354 @@
+"""AST-based invariant linter for the consensus core.
+
+The linter walks every ``.py`` file under the given paths, parses it once,
+and runs each registered rule (:mod:`tpu_swirld.analysis.rules`) whose
+scope covers the module.  Rules are *project-specific invariants*, not
+style: every finding names a concrete consensus-safety, jit-discipline, or
+thread-safety hazard and carries a fix-it message.
+
+Suppression syntax
+------------------
+
+A finding is suppressed by a comment on the flagged line::
+
+    for tip in self.branch_tips[m]:   # swirld-lint: disable=SW002
+
+Multiple ids separate with commas (``disable=SW002,SW005``); rule *names*
+work too (``disable=unordered-iter``); ``disable=all`` silences the line.
+A file-level escape hatch — ``# swirld-lint: disable-file=SW004`` within
+the first ten lines — exists for generated or vendored code; the package
+itself must not need it.
+
+Programmatic use::
+
+    from tpu_swirld.analysis import lint_paths
+    findings = lint_paths(["tpu_swirld"])      # [] == clean tree
+
+``check_source(source, module_path=...)`` lints a string against a
+virtual module path (the per-rule fixture tests use this to place bad
+snippets inside consensus-critical scopes).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import tokenize
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: the package directory name that anchors rule scopes
+_PKG = "tpu_swirld"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str          # rule id, e.g. "SW002"
+    name: str          # rule slug, e.g. "unordered-iter"
+    path: str          # file path as given to the linter
+    line: int
+    col: int
+    message: str       # what is wrong + the fix-it
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: "
+            f"{self.rule}[{self.name}] {self.message}"
+        )
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+class FileContext:
+    """Everything a rule needs about one file: parsed tree, source lines,
+    the module path used for scoping, and the cross-file package index."""
+
+    def __init__(
+        self,
+        path: str,
+        source: str,
+        module_path: str,
+        index: "PackageIndex",
+    ):
+        self.path = path
+        self.source = source
+        self.module_path = module_path
+        self.index = index
+        self.tree = ast.parse(source, filename=path)
+        self.lines = source.splitlines()
+
+
+class PackageIndex:
+    """Cross-file facts collected before per-file rule checks.
+
+    ``donations`` maps a function name to the tuple of its
+    ``donate_argnums`` positions; ``donation_factories`` maps a factory
+    function name (``make_*`` returning a jitted inner def) to the inner
+    def's donated positions.  The donation-discipline rule resolves call
+    sites against both, so a buffer donated through a factory-produced
+    stage is tracked exactly like a module-level one.
+    """
+
+    def __init__(self):
+        self.donations: Dict[str, Tuple[int, ...]] = {}
+        self.donation_factories: Dict[str, Tuple[int, ...]] = {}
+
+    def scan(self, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            pos = _donated_positions(node)
+            if pos:
+                self.donations[node.name] = pos
+            else:
+                inner = [
+                    n for n in node.body
+                    if isinstance(n, ast.FunctionDef)
+                    and _donated_positions(n)
+                ]
+                if inner:
+                    self.donation_factories[node.name] = (
+                        _donated_positions(inner[0])
+                    )
+
+
+def _donated_positions(fn: ast.FunctionDef) -> Tuple[int, ...]:
+    """``donate_argnums`` positions from a ``@jax.jit`` /
+    ``@functools.partial(jax.jit, donate_argnums=...)`` decorator."""
+    for dec in fn.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        is_partial = (
+            isinstance(dec.func, ast.Attribute)
+            and dec.func.attr == "partial"
+        ) or (isinstance(dec.func, ast.Name) and dec.func.id == "partial")
+        is_jit = (
+            isinstance(dec.func, ast.Attribute) and dec.func.attr == "jit"
+        )
+        if not (is_partial or is_jit):
+            continue
+        for kw in dec.keywords:
+            if kw.arg != "donate_argnums":
+                continue
+            v = kw.value
+            if isinstance(v, ast.Tuple):
+                out = []
+                for e in v.elts:
+                    if isinstance(e, ast.Constant) and isinstance(
+                        e.value, int
+                    ):
+                        out.append(e.value)
+                return tuple(out)
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+    return ()
+
+
+# ----------------------------------------------------------- suppression
+
+
+def _suppressions(source: str) -> Tuple[Dict[int, set], set]:
+    """``(per_line, per_file)`` suppression sets parsed from
+    ``# swirld-lint:`` comments (rule ids, rule names, or ``all``)."""
+    per_line: Dict[int, set] = {}
+    per_file: set = set()
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in toks:
+            if tok.type != tokenize.COMMENT:
+                continue
+            text = tok.string.lstrip("#").strip()
+            if not text.startswith("swirld-lint:"):
+                continue
+            body = text[len("swirld-lint:"):].strip()
+            if body.startswith("disable-file="):
+                if tok.start[0] <= 10:
+                    per_file.update(
+                        x.strip()
+                        for x in body[len("disable-file="):].split(",")
+                    )
+            elif body.startswith("disable="):
+                ids = {
+                    x.strip() for x in body[len("disable="):].split(",")
+                }
+                per_line.setdefault(tok.start[0], set()).update(ids)
+    except tokenize.TokenError:
+        pass
+    return per_line, per_file
+
+
+def _suppressed(f: Finding, per_line: Dict[int, set], per_file: set) -> bool:
+    for ids in (per_file, per_line.get(f.line, ())):
+        if ids and (f.rule in ids or f.name in ids or "all" in ids):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------- driver
+
+
+def module_path(path: str) -> str:
+    """Scope key for a file: its posix path relative to the ``tpu_swirld``
+    package root (``oracle/node.py``), or the bare filename for files
+    outside the package (scripts, tests)."""
+    parts = path.replace(os.sep, "/").split("/")
+    if _PKG in parts:
+        i = len(parts) - 1 - parts[::-1].index(_PKG)
+        rel = "/".join(parts[i + 1:])
+        if rel:
+            return rel
+    return parts[-1]
+
+
+def collect_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d not in ("__pycache__", ".git")
+                )
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        out.append(os.path.join(root, f))
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+def _load_rules(only: Optional[Sequence[str]] = None):
+    from tpu_swirld.analysis.rules import all_rules
+
+    rules = all_rules()
+    if only:
+        sel = set(only)
+        rules = [r for r in rules if r.id in sel or r.name in sel]
+    return rules
+
+
+def lint_paths(
+    paths: Sequence[str],
+    *,
+    rules: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Lint every ``.py`` file under ``paths``; returns all unsuppressed
+    findings sorted by location."""
+    files = collect_files(paths)
+    index = PackageIndex()
+    parsed: List[Tuple[str, str, ast.AST]] = []
+    findings: List[Finding] = []
+    for path in files:
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as exc:
+            findings.append(
+                Finding(
+                    "SW000", "syntax", path, exc.lineno or 0, 0,
+                    f"file does not parse: {exc.msg}",
+                )
+            )
+            continue
+        index.scan(tree)
+        parsed.append((path, source, tree))
+    rule_objs = _load_rules(rules)
+    for path, source, tree in parsed:
+        ctx = FileContext(path, source, module_path(path), index)
+        per_line, per_file = _suppressions(source)
+        for rule in rule_objs:
+            if not rule.applies(ctx.module_path):
+                continue
+            for f in rule.check(ctx):
+                if not _suppressed(f, per_line, per_file):
+                    findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def check_source(
+    source: str,
+    *,
+    module_path: str = "module.py",
+    path: str = "<string>",
+    rules: Optional[Sequence[str]] = None,
+    index: Optional[PackageIndex] = None,
+) -> List[Finding]:
+    """Lint a source string against a virtual module path (fixture
+    helper: place a snippet "inside" ``oracle/node.py`` to hit scoped
+    rules).  The donation index is built from the snippet itself unless
+    an explicit ``index`` is passed."""
+    if index is None:
+        index = PackageIndex()
+        index.scan(ast.parse(source))
+    ctx = FileContext(path, source, module_path, index)
+    per_line, per_file = _suppressions(source)
+    out = []
+    for rule in _load_rules(rules):
+        if not rule.applies(ctx.module_path):
+            continue
+        for f in rule.check(ctx):
+            if not _suppressed(f, per_line, per_file):
+                out.append(f)
+    out.sort(key=lambda f: (f.line, f.col, f.rule))
+    return out
+
+
+def lint_summary(findings: Sequence[Finding]) -> Dict:
+    """The shape stamped into bench JSON artifacts (``bench_compare.py``
+    refuses to gate a run produced from a tree with findings)."""
+    by_rule: Dict[str, int] = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    return {
+        "findings": len(findings),
+        "clean": not findings,
+        "by_rule": dict(sorted(by_rule.items())),
+    }
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu_swirld.analysis lint",
+        description=__doc__.splitlines()[0],
+    )
+    ap.add_argument("paths", nargs="*", default=[_PKG])
+    ap.add_argument("--json", action="store_true", help="machine output")
+    ap.add_argument(
+        "--rules", help="comma-separated rule ids/names to run (default all)"
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    args = ap.parse_args(argv)
+    if args.list_rules:
+        for r in _load_rules():
+            print(f"{r.id} {r.name:<22} scope={','.join(r.scope) or '*'}")
+            print(f"      {r.describe}")
+        return 0
+    only = args.rules.split(",") if args.rules else None
+    findings = lint_paths(args.paths or [_PKG], rules=only)
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    **lint_summary(findings),
+                    "items": [f.to_dict() for f in findings],
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render())
+        n = len(findings)
+        print(f"{n} finding{'s' if n != 1 else ''}")
+    return 1 if findings else 0

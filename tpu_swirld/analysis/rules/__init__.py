@@ -1,0 +1,82 @@
+"""Rule catalog for the invariant linter.
+
+Each rule is a small AST pass with a fixed id (``SWNNN``), a slug, a
+module scope (empty = whole package), and a fix-it message baked into
+every finding.  Suppress a finding with ``# swirld-lint: disable=<id>``
+on the flagged line (see :mod:`tpu_swirld.analysis.lint`).
+
+Catalog:
+
+- **SW001 unseeded-rng** — no global-state RNG (``random.*``,
+  ``np.random.*``) anywhere in the package; randomness must flow from a
+  seeded ``random.Random`` / ``np.random.default_rng(seed)`` instance.
+- **SW002 unordered-iter** — no hash-order ``set`` iteration in the
+  consensus-critical modules (``oracle/``, ``store/streaming.py``,
+  ``tpu/pipeline.py``, ``chaos.py``) without an explicit ``sorted()``.
+- **SW003 wall-clock** — no ``time.time`` / ``time.sleep`` /
+  ``datetime.now`` in the logical-time transport/retry layer.
+- **SW004 dtype-discipline** — kernel/slab allocations (``tpu/``,
+  ``store/``, ``parallel.py``) must pin an explicit dtype; NumPy's
+  implicit int64/float64 promotion and builtin-``int`` dtypes are
+  forbidden.
+- **SW005 donation-discipline** — a buffer passed at a
+  ``donate_argnums`` position (directly, through ``obs.stage_call``, or
+  through a ``make_*`` stage factory) must not be read afterwards in the
+  same scope until rebound.
+- **SW006 lock-discipline** — every ``self`` attribute a background
+  worker thread touches must appear in the owning class's declared
+  ``GUARDED_ATTRS`` frozenset.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Tuple
+
+from tpu_swirld.analysis.lint import FileContext, Finding
+
+
+class Rule:
+    """Base class: subclasses set ``id``/``name``/``scope``/``describe``
+    and implement :meth:`check`."""
+
+    id: str = "SW000"
+    name: str = "base"
+    describe: str = ""
+    #: module-path prefixes this rule applies to; empty = every module
+    scope: Tuple[str, ...] = ()
+
+    def applies(self, module_path: str) -> bool:
+        if not self.scope:
+            return True
+        return any(
+            module_path == s or module_path.startswith(s)
+            for s in self.scope
+        )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: FileContext, node, message: str) -> Finding:
+        return Finding(
+            self.id, self.name, ctx.path,
+            getattr(node, "lineno", 0), getattr(node, "col_offset", 0),
+            message,
+        )
+
+
+def all_rules() -> List[Rule]:
+    from tpu_swirld.analysis.rules.determinism import (
+        UnorderedIterRule, UnseededRngRule, WallClockRule,
+    )
+    from tpu_swirld.analysis.rules.donation import DonationRule
+    from tpu_swirld.analysis.rules.dtype import DtypeRule
+    from tpu_swirld.analysis.rules.locks import LockDisciplineRule
+
+    return [
+        UnseededRngRule(),
+        UnorderedIterRule(),
+        WallClockRule(),
+        DtypeRule(),
+        DonationRule(),
+        LockDisciplineRule(),
+    ]

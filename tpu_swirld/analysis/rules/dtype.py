@@ -1,0 +1,100 @@
+"""SW004 dtype-discipline: explicit dtypes in kernel/slab code.
+
+NumPy allocation defaults are platform-flavored (``np.arange`` without a
+dtype is int64 on linux); jnp defaults to int32/float32 with x64
+disabled.  A slab or index array that silently lands in int64 doubles
+HBM traffic, breaks the int32 kernels' shape buckets, and — worst —
+recompiles every stage the array feeds.  The rule forbids implicit
+dtypes on array allocations in ``tpu/``, ``store/``, and
+``parallel.py``, plus builtin-``int``/``float`` as dtype arguments
+(their width is platform-defined).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from tpu_swirld.analysis.lint import FileContext, Finding
+from tpu_swirld.analysis.rules import Rule
+
+#: allocator -> number of positional args at which dtype is covered
+#: (np.zeros(shape, dtype) -> 2 positionals mean dtype was passed)
+_NP_ALLOCATORS = {
+    "zeros": 2, "ones": 2, "empty": 2, "arange": 4, "full": 3,
+}
+#: jnp.arange defaults to int32 (x64 off) so it is exempt; the others
+#: still deserve an explicit dtype for reviewability + weak_type control
+_JNP_ALLOCATORS = {"zeros": 2, "ones": 2, "empty": 2, "full": 3, "eye": 3}
+
+#: builtin ``bool`` is exempt: as a dtype it IS np.bool_ (1 byte
+#: everywhere); int/float are C-long/double and platform-flavored
+_BUILTIN_DTYPES = {"int", "float"}
+
+
+class DtypeRule(Rule):
+    id = "SW004"
+    name = "dtype-discipline"
+    describe = (
+        "kernel/slab allocations must pin an explicit dtype "
+        "(np defaults promote to int64/float64 and break the int32 "
+        "shape buckets); builtin int/float dtypes are platform-width"
+    )
+    scope = ("tpu/", "store/", "parallel.py")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and isinstance(
+                fn.value, ast.Name
+            ):
+                mod, attr = fn.value.id, fn.attr
+                table = None
+                if mod in ("np", "numpy"):
+                    table = _NP_ALLOCATORS
+                elif mod == "jnp":
+                    table = _JNP_ALLOCATORS
+                if table is not None and attr in table:
+                    has_dtype_kw = any(
+                        kw.arg == "dtype" for kw in node.keywords
+                    )
+                    if not has_dtype_kw and len(node.args) < table[attr]:
+                        default = (
+                            "int64" if attr == "arange" else
+                            "float64 (np) / weak float32 (jnp)"
+                        )
+                        out.append(self.finding(
+                            ctx, node,
+                            f"{mod}.{attr}(...) without an explicit dtype "
+                            f"defaults to {default} — doubles slab bytes "
+                            "and recompiles int32 stages; fix: pass "
+                            "dtype=np.int32 / np.bool_ / the slab's "
+                            "matmul dtype explicitly",
+                        ))
+                # .astype(int) and friends
+                if attr == "astype" and node.args:
+                    a = node.args[0]
+                    if isinstance(a, ast.Name) and a.id in _BUILTIN_DTYPES:
+                        out.append(self.finding(
+                            ctx, node,
+                            f".astype({a.id}) uses the platform-width "
+                            "builtin; fix: name the width "
+                            "(np.int32 / np.float32 / np.bool_)",
+                        ))
+            # dtype=int / dtype=float keyword on any call
+            for kw in node.keywords:
+                if (
+                    kw.arg == "dtype"
+                    and isinstance(kw.value, ast.Name)
+                    and kw.value.id in _BUILTIN_DTYPES
+                ):
+                    out.append(self.finding(
+                        ctx, kw.value,
+                        f"dtype={kw.value.id} is the platform-width "
+                        "builtin; fix: name the width "
+                        "(np.int32 / np.float32 / np.bool_)",
+                    ))
+        return out

@@ -161,6 +161,19 @@ def phase_scope(metrics, tracer, name: str):
         yield
 
 
+# Audit seam: tpu_swirld.analysis.jit_audit installs a callback here to
+# record every stage call's abstract signature (shape/dtype/weak_type per
+# arg) without touching values.  None in production — one global read.
+_stage_observer = None
+
+
+def set_stage_observer(cb) -> None:
+    """Install (or clear, with None) the stage-call observer: called as
+    ``cb(name, fn, args, kw)`` before every observed stage dispatch."""
+    global _stage_observer
+    _stage_observer = cb
+
+
 def stage_call(name: str, fn, *args, **kw):
     """Run a jitted stage under the ambient Obs (no-op pass-through when
     disabled): spans the call, blocks on the result so the span measures
@@ -171,6 +184,9 @@ def stage_call(name: str, fn, *args, **kw):
     that's the point (per-stage attribution); leave it disabled for
     maximum-overlap production runs.
     """
+    so = _stage_observer
+    if so is not None:
+        so(name, fn, args, kw)
     o = current()
     if o is None:
         return fn(*args, **kw)

@@ -500,7 +500,7 @@ class Node:
             # per reply instead of the old O(full history).
             miss = max(len(known) - heights[m], 0)
             extra: set = set()
-            for tip in self.branch_tips[m]:
+            for tip in sorted(self.branch_tips[m]):
                 cur: Optional[bytes] = tip
                 for _ in range(miss + 1):
                     if cur is None or cur in extra:
@@ -509,7 +509,7 @@ class Node:
                     cur = self.hg[cur].self_parent
             first_seq = min(self.fork_groups[m])
             extra.update(self.fork_groups[m][first_seq])
-            missing.extend(extra)
+            missing.extend(sorted(extra))
         return self._sign_event_blob(missing)
 
     def _sign_event_blob(self, ids: List[bytes]) -> bytes:

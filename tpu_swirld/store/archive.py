@@ -75,12 +75,38 @@ from tpu_swirld.config import resolve_archive_settings
 #: LRU capacity (decompressed rows) for the reconstruction/fetch cache
 _ROW_CACHE_ENTRIES = 1024
 
+# Schedule-fuzz seam: tpu_swirld.analysis.races installs a yield injector
+# here to perturb client/worker interleavings at the tagged points below.
+# None in production — each point costs one global read + None check.
+_injector = None
+
+
+def set_injector(inj) -> None:
+    global _injector
+    _injector = inj
+
+
+def _yp(tag: str) -> None:
+    inj = _injector
+    if inj is not None:
+        inj.point(tag)
+
 
 class SlabArchive:
     """Append-only archive of decided ancestry rows (see module doc)."""
 
     #: archive format version (bump on layout changes)
     FORMAT_VERSION = 1
+
+    #: every mutable attribute the pack worker shares with the client
+    #: thread (SW006 lock-discipline): the spill queue itself, the blob
+    #: list / byte counter / row cache it packs into behind the drain
+    #: barrier, the failure slot, and the busy-time counter.  Audit any
+    #: addition here against the queue/barrier protocol in the module doc.
+    GUARDED_ATTRS = frozenset({
+        "_q", "_rows", "_cache", "_committed_bytes", "_worker_err",
+        "busy_seconds",
+    })
 
     def __init__(
         self,
@@ -153,6 +179,7 @@ class SlabArchive:
         if cached is not None:
             self._cache.move_to_end(e)
             return cached
+        _yp("archive.cache.miss")
         raw = np.frombuffer(zlib.decompress(self._rows[e]), dtype=np.uint8)
         row = np.unpackbits(raw, count=e + 1).astype(bool)
         row.flags.writeable = False
@@ -162,15 +189,21 @@ class SlabArchive:
         return row
 
     def _append_bool(self, row: np.ndarray) -> None:
+        _yp("archive.append")
         blob = zlib.compress(np.packbits(row).tobytes(), self._level)
         self._rows.append(blob)
         self._committed_bytes += len(blob)
 
     # ------------------------------------------------- background worker
 
+    def _make_queue(self, maxsize: int) -> queue.Queue:
+        """Seam for analysis.races: the sanitized subclass returns a queue
+        whose internal lock participates in the lock-order graph."""
+        return queue.Queue(maxsize=maxsize)
+
     def _ensure_worker(self) -> queue.Queue:
         if self._q is None:
-            self._q = queue.Queue(maxsize=max(1, int(self.queue_depth)))
+            self._q = self._make_queue(max(1, int(self.queue_depth)))
             self._worker = threading.Thread(
                 target=self._worker_loop, name="slab-archive-pack",
                 daemon=True,
@@ -184,6 +217,7 @@ class SlabArchive:
             try:
                 if item is None:
                     return
+                _yp("archive.worker.item")
                 t0 = time.perf_counter()
                 kind, args = item
                 if kind == "spill":
@@ -205,6 +239,7 @@ class SlabArchive:
         """Barrier: wait until every queued batch is packed, then re-raise
         any worker failure.  All reads of archived content go through
         here, so async and sync spilling are observationally identical."""
+        _yp("archive.drain")
         if self._q is not None and (
             self._q.unfinished_tasks or not self._q.empty()
         ):
@@ -217,6 +252,7 @@ class SlabArchive:
 
     def _enqueue(self, item) -> None:
         q = self._ensure_worker()
+        _yp("archive.enqueue")
         self.max_queue_depth = max(self.max_queue_depth, q.qsize() + 1)
         o = obs.current()
         if o is not None:

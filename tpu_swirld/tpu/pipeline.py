@@ -1516,7 +1516,7 @@ def _columns_pass(
                     break
                 rnd_np = np.asarray(out[0])
                 # was a missing witness's round queried later in this chunk?
-                ce = np.arange(start, start + chunk_size)
+                ce = np.arange(start, start + chunk_size, dtype=np.int64)
                 p = parents_np[ce]
                 r0 = np.where(
                     p[:, 0] < 0,
@@ -2339,7 +2339,7 @@ class IncrementalConsensus:
         if w_used:
             order = np.argsort(cre, kind="stable")
             starts = np.concatenate(([0], np.cumsum(counts)[:-1]))
-            kpos = np.arange(w_used) - np.repeat(starts, counts)
+            kpos = np.arange(w_used, dtype=np.int64) - np.repeat(starts, counts)
             self._mt_np[cre[order], kpos] = order.astype(np.int32)
 
     def _materialize_sees(self) -> None:
@@ -2599,7 +2599,7 @@ class IncrementalConsensus:
                     state = out
                     break
                 rnd_np = np.asarray(out[0])
-                ce = np.arange(start, start + chunk)
+                ce = np.arange(start, start + chunk, dtype=np.int64)
                 pc = self._parents_w[ce]
                 r0 = np.where(
                     pc[:, 0] < 0,
