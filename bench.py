@@ -577,6 +577,85 @@ def run_stream(tile_budget, tile, mesh_n=0, device_tile_budget=None):
         sys.exit(1)
 
 
+def run_chaos_overhead():
+    """--chaos-overhead: device-pipeline throughput under an active
+    equivocation storm vs the same shape fault-free, in one JSON line.
+
+    Two DAGs share (members, stake, seed): the attack DAG runs
+    ``f = (n-1)//3`` forking creators at high fork probability (the
+    in-budget worst case — fork pairs inflate the witness table and the
+    identical-set checks), the clean DAG is fault-free.  Each is packed
+    and run through ``run_consensus`` once to compile, then timed, and
+    the line reports ``chaos_overhead.{clean_evps, attack_evps, ratio}``
+    (ratio = attack/clean, higher is better) so bench_compare.py can
+    gate adversary-path overhead like any other throughput number.
+
+    Env knobs: BENCH_CHAOS_MEMBERS (32), BENCH_CHAOS_EVENTS (4000),
+    BENCH_CHAOS_FORK_PROB (0.4).
+    """
+    tpu_ok = probe_tpu()
+    import jax
+
+    if not tpu_ok:
+        jax.config.update("jax_platforms", "cpu")
+    platform = jax.devices()[0].platform
+    log(f"[env] platform={platform} devices={len(jax.devices())}")
+
+    from tpu_swirld.config import SwirldConfig
+    from tpu_swirld.packing import pack_events
+    from tpu_swirld.sim import generate_gossip_dag
+    from tpu_swirld.tpu.pipeline import run_consensus
+
+    n_members = int(os.environ.get("BENCH_CHAOS_MEMBERS", "32"))
+    n_events = int(os.environ.get("BENCH_CHAOS_EVENTS", "4000"))
+    fork_prob = float(os.environ.get("BENCH_CHAOS_FORK_PROB", "0.4"))
+    f_budget = (n_members - 1) // 3
+    config = SwirldConfig(n_members=n_members)
+
+    legs = {}
+    for leg, n_forkers in (("clean", 0), ("attack", f_budget)):
+        t0 = time.time()
+        members, stake, events, _keys = generate_gossip_dag(
+            n_members, n_events, seed=2, n_forkers=n_forkers,
+            fork_prob=fork_prob if n_forkers else 0.0,
+        )
+        packed = pack_events(events, members, stake)
+        log(f"[{leg}] {n_members} members / {len(events)} events, "
+            f"{int(packed.fork_pairs.shape[0])} fork pairs "
+            f"({time.time()-t0:.1f}s gen+pack)")
+        run_consensus(packed, config)          # compile + warm
+        t0 = time.time()
+        res = run_consensus(packed, config)
+        dt = time.time() - t0
+        legs[leg] = {
+            "evps": round(len(events) / dt, 1),
+            "fork_pairs": int(packed.fork_pairs.shape[0]),
+            "overflow_retries": int(res.timings.get("overflow_retries", 0)),
+        }
+        log(f"[{leg}] {legs[leg]['evps']:.0f} ev/s")
+
+    ratio = legs["attack"]["evps"] / legs["clean"]["evps"]
+    out = {
+        "metric": "chaos_overhead_evps",
+        "value": legs["attack"]["evps"],
+        "unit": "events/sec",
+        "platform": platform,
+        "chaos_overhead": {
+            "clean_evps": legs["clean"]["evps"],
+            "attack_evps": legs["attack"]["evps"],
+            "ratio": round(ratio, 4),
+            "n_members": n_members,
+            "n_events": n_events,
+            "n_forkers": f_budget,
+            "fork_prob": fork_prob,
+            "fork_pairs": legs["attack"]["fork_pairs"],
+            "overflow_retries": legs["attack"]["overflow_retries"],
+        },
+        "lint": lint_stamp(),
+    }
+    print(json.dumps(out), flush=True)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument(
@@ -605,8 +684,17 @@ def main(argv=None):
         help="with --mesh: per-device resident tile bound (widest row "
         "shard); 0 = unbounded (account only)",
     )
+    ap.add_argument(
+        "--chaos-overhead", action="store_true",
+        help="stamp device-pipeline ev/s with an equivocation storm at "
+        "the full f=(n-1)//3 budget vs fault-free into a "
+        "chaos_overhead JSON object (BENCH_CHAOS_* overrides); "
+        "bench_compare.py gates clean/attack ev/s and their ratio",
+    )
     args = ap.parse_args(argv)
-    if args.stream:
+    if args.chaos_overhead:
+        run_chaos_overhead()
+    elif args.stream:
         run_stream(
             args.tile_budget or None, args.tile,
             mesh_n=args.mesh,

@@ -59,6 +59,13 @@ EXTRA_KEYS = [
     ("stream_mesh.scaling_efficiency", True),
     ("stream_mesh.peak_device_tiles", False),
     ("stream_mesh.repins", False),
+    # adversary-overhead artifacts (bench.py --chaos-overhead): ev/s with
+    # an equivocation storm at the full f budget, fault-free ev/s on the
+    # same shape, and their ratio (attack/clean — a falling ratio means
+    # the adversary path got relatively more expensive)
+    ("chaos_overhead.clean_evps", True),
+    ("chaos_overhead.attack_evps", True),
+    ("chaos_overhead.ratio", True),
 ]
 
 

@@ -11,11 +11,15 @@ Reproduce any run from its seeds:
 
     python scripts/chaos_run.py --seed 7 --plan-seed 7 --out verdict.json
 
-Named storm scenarios (``--scenario``): ``horizon_storm`` fires straggler
-witnesses across a healing partition and asserts cross-engine bit-parity
-under the deterministic expiry horizon; ``overflow_storm`` drives the
-witness-table self-healing paths (fork-storm s_max doubling, round-clamp
-unclamped retry) and asserts parity with the oracle.
+Named scenarios (``--scenario``) come from one registry: everything in
+:data:`tpu_swirld.adversary.SCENARIOS` — the active-byzantine suite
+(``equivocation_storm``, ``censorship``, ``delayed_release``,
+``fork_bomb``, ``fork_bomb_overbudget``) plus the storms
+(``horizon_storm``: straggler witnesses across a healing partition under
+the deterministic expiry horizon; ``overflow_storm``: witness-table
+self-healing) — auto-appears here.  ``--scenario list`` prints the
+registry; ``--all`` runs every scenario and writes one aggregate verdict
+JSON gated on the AND of all verdicts.
 
 The default schedule scales with --turns: partition cuts the first two
 members during the middle third; the last member crashes at 1/4 and
@@ -32,12 +36,8 @@ import tempfile
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from tpu_swirld import obs                                    # noqa: E402
-from tpu_swirld.chaos import (                                # noqa: E402
-    ChaosScenario,
-    ChaosSimulation,
-    run_horizon_storm,
-    run_overflow_storm,
-)
+from tpu_swirld.adversary import SCENARIOS                    # noqa: E402
+from tpu_swirld.chaos import ChaosScenario, ChaosSimulation   # noqa: E402
 from tpu_swirld.metrics import Metrics                        # noqa: E402
 from tpu_swirld.transport import FaultPlan, LinkFaults, Partition  # noqa: E402
 
@@ -66,16 +66,10 @@ def build_scenario(args) -> ChaosScenario:
     )
 
 
-def run_scenario(args, ckpt_dir, o) -> dict:
-    """One full scenario run under the ambient Obs ``o``; returns the
-    verdict dict (shared by the main run and --sanitize re-runs)."""
-    if args.scenario == "horizon_storm":
-        return run_horizon_storm(
-            ckpt_dir, seed=args.seed, metrics=Metrics(o.registry),
-            engine=args.engine,
-        )
-    if args.scenario == "overflow_storm":
-        return run_overflow_storm(seed=args.seed)
+def _run_acceptance(args, ckpt_dir, o) -> dict:
+    """The composed fault scenario: lossy/reordering transport, one
+    scheduled partition + heal, one crash + checkpoint-restart, optional
+    equivocating forkers; cross-engine parity over the surviving DAG."""
     sim = ChaosSimulation(
         build_scenario(args), ckpt_dir, metrics=Metrics(o.registry),
     )
@@ -94,6 +88,33 @@ def run_scenario(args, ckpt_dir, o) -> dict:
         and engines["incremental_batch_parity"]
     )
     return verdict
+
+
+def _adapt(fn):
+    """Registry runner -> CLI runner: scenarios registered in
+    :data:`tpu_swirld.adversary.SCENARIOS` share the uniform signature
+    ``fn(ckpt_dir, seed=, engine=, metrics=)``; ``--seed`` left at its
+    default passes ``None`` so each scenario keeps its pinned seed."""
+    def run(args, ckpt_dir, o) -> dict:
+        return fn(
+            ckpt_dir, seed=args.seed, engine=args.engine,
+            metrics=Metrics(o.registry),
+        )
+    return run
+
+
+#: CLI scenario registry: name -> runner(args, ckpt_dir, o).  Everything
+#: registered in tpu_swirld.adversary.SCENARIOS (the byzantine strategy
+#: suite plus the named storms) auto-appears in --scenario and --all;
+#: only the composed acceptance scenario needs the full argparse surface.
+RUNNERS = {"acceptance": _run_acceptance}
+RUNNERS.update({name: _adapt(fn) for name, fn in SCENARIOS.items()})
+
+
+def run_scenario(args, ckpt_dir, o) -> dict:
+    """One full scenario run under the ambient Obs ``o``; returns the
+    verdict dict (shared by the main run, --all, and --sanitize re-runs)."""
+    return RUNNERS[args.scenario](args, ckpt_dir, o)
 
 
 def _verdict_fingerprint(verdict: dict) -> tuple:
@@ -149,12 +170,20 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument(
         "--scenario",
-        choices=("acceptance", "horizon_storm", "overflow_storm"),
+        choices=("list",) + tuple(RUNNERS),
         default="acceptance",
-        help="acceptance: the composed fault scenario (default); "
-        "horizon_storm: straggler witnesses across a healing partition, "
-        "cross-engine bit-parity verdict; overflow_storm: witness-table "
-        "self-healing (fork storm + round clamp) verdict",
+        help="named scenario to run (default: acceptance, the composed "
+        "fault scenario).  'list' prints every registered scenario with "
+        "its one-line description and exits; scenarios registered in "
+        "tpu_swirld.adversary.SCENARIOS (equivocation_storm, censorship, "
+        "delayed_release, fork_bomb, fork_bomb_overbudget, horizon_storm, "
+        "overflow_storm) appear here automatically",
+    )
+    ap.add_argument(
+        "--all", action="store_true",
+        help="run every registered scenario and write one aggregate "
+        "verdict JSON ({scenarios: {name: verdict}, ok: AND of all}); "
+        "exit 0 iff every scenario verdict is ok",
     )
     ap.add_argument(
         "--engine",
@@ -171,7 +200,11 @@ def main(argv=None) -> int:
         "acceptance scenario gains an 'engines' verdict section; the "
         "storm scenarios replay with the chosen driver.",
     )
-    ap.add_argument("--seed", type=int, default=0, help="population seed")
+    ap.add_argument(
+        "--seed", type=int, default=None,
+        help="population seed (default: 0 for acceptance; registered "
+        "scenarios keep their pinned per-scenario seed)",
+    )
     ap.add_argument("--plan-seed", type=int, default=0, help="fault stream seed")
     ap.add_argument("--nodes", type=int, default=6)
     ap.add_argument("--turns", type=int, default=360)
@@ -193,14 +226,48 @@ def main(argv=None) -> int:
     ap.add_argument("--out", default="chaos_verdict.json")
     args = ap.parse_args(argv)
 
+    if args.scenario == "list":
+        for name, fn in RUNNERS.items():
+            doc = (
+                SCENARIOS[name].__doc__ if name in SCENARIOS else fn.__doc__
+            ) or ""
+            first = next(
+                (ln.strip() for ln in doc.splitlines() if ln.strip()), ""
+            )
+            print(f"{name:24s} {first}")
+        return 0
+    if args.seed is None and not args.all and args.scenario == "acceptance":
+        args.seed = 0
+
+    if args.all:
+        if args.sanitize:
+            ap.error("--all and --sanitize are mutually exclusive")
+        results = {}
+        for name in RUNNERS:
+            sub = argparse.Namespace(**{**vars(args), "scenario": name})
+            if name == "acceptance" and sub.seed is None:
+                sub.seed = 0
+            with tempfile.TemporaryDirectory(prefix="chaos-ckpt-") as d:
+                with obs.enabled() as o:
+                    results[name] = run_scenario(sub, d, o)
+            print(f"{name:24s} {'OK' if results[name]['ok'] else 'FAIL'}")
+        verdict = {
+            "ok": all(v["ok"] for v in results.values()),
+            "scenarios": results,
+        }
+        with open(args.out, "w") as f:
+            json.dump(verdict, f, indent=2, sort_keys=True)
+        print(f"verdict: {'OK' if verdict['ok'] else 'FAIL'} -> {args.out}")
+        return 0 if verdict["ok"] else 1
+
     if args.scenario != "acceptance":
-        # the storm scenarios carry their own built-in population / fault
-        # schedule; only --seed parameterizes them — say so instead of
-        # silently attributing the verdict to knobs that never applied
+        # the registered scenarios carry their own built-in population /
+        # fault schedule; only --seed/--engine parameterize them — say so
+        # instead of silently attributing the verdict to knobs that never
+        # applied
         print(
             f"note: --scenario {args.scenario} uses its built-in schedule; "
-            "only --seed (and, for horizon_storm, --engine) applies "
-            "(other knobs ignored)",
+            "only --seed and --engine apply (other knobs ignored)",
             file=sys.stderr,
         )
     with tempfile.TemporaryDirectory(prefix="chaos-ckpt-") as ckpt_dir:
@@ -216,7 +283,7 @@ def main(argv=None) -> int:
     with open(args.out, "w") as f:
         json.dump(verdict, f, indent=2, sort_keys=True)
     for key in ("safety", "liveness", "horizon", "fork_storm", "round_clamp",
-                "engines", "sanitizer"):
+                "adversary", "engines", "sanitizer"):
         if key in verdict:
             print(json.dumps({key: verdict[key]}, sort_keys=True))
     print(f"verdict: {'OK' if verdict['ok'] else 'FAIL'} -> {args.out}")

@@ -174,7 +174,10 @@ class UnorderedIterRule(Rule):
         "consensus-critical modules iterate sorted(the_set) or an "
         "ordered container"
     )
-    scope = ("oracle/", "store/streaming.py", "tpu/pipeline.py", "chaos.py")
+    scope = (
+        "oracle/", "store/streaming.py", "tpu/pipeline.py", "chaos.py",
+        "adversary.py",
+    )
 
     _FIX = (
         "iterates a set — order is hash-randomized per process, so two "

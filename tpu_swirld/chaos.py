@@ -62,8 +62,19 @@ class ChaosScenario:
     """One seeded chaos run: population shape + fault schedule.
 
     ``plan.crashes`` / ``plan.partitions`` use member indices; crash
-    windows must name honest members (indices >= ``n_forkers``) and close
-    before ``n_turns`` so the liveness claim is testable.
+    windows must name honest members (indices >= ``n_forkers`` and not in
+    ``adversaries``) and close before ``n_turns`` so the liveness claim
+    is testable.
+
+    ``adversaries`` installs active byzantine drivers from
+    :mod:`tpu_swirld.adversary`: member index -> ``factory(sim, index)``
+    returning an object with ``ask_sync`` / ``ask_events`` endpoints and
+    an optional ``step(turn, honest_pks)`` called every turn.  These
+    compose with the legacy ``n_forkers`` divergent forkers and with the
+    fault plan — byzantine and network faults share one transport.
+    ``attack_end`` extends the liveness horizon: decided progress is
+    measured after ``max(plan.heal_time(), attack_end)``, so a timed
+    attack window counts as a fault the run must recover from.
     """
 
     n_nodes: int = 5
@@ -75,6 +86,14 @@ class ChaosScenario:
     checkpoint_every: int = 50
     recovery_pull_rounds: int = 3   # max pull-only sweeps after a restart
     tpu_node_index: Optional[int] = None  # honest member on backend="tpu"
+    adversaries: Optional[Dict[int, Callable]] = None  # index -> factory
+    attack_end: int = 0             # last turn of the attack window
+
+    def byzantine_indices(self) -> set:
+        byz = set(range(self.n_forkers))
+        if self.adversaries:
+            byz.update(self.adversaries)
+        return byz
 
     def config(self) -> SwirldConfig:
         return SwirldConfig(
@@ -94,14 +113,15 @@ class ChaosSimulation:
         on_turn: Optional[Callable[[int, "ChaosSimulation"], None]] = None,
     ):
         sc = scenario
-        heal = sc.plan.heal_time()
+        byz = sc.byzantine_indices()
+        heal = max(sc.plan.heal_time(), sc.attack_end)
         if heal >= sc.n_turns:
             raise ValueError(
                 f"fault schedule ends at t={heal} but the run is only "
                 f"{sc.n_turns} turns; liveness-after-heal is untestable"
             )
         for idx, windows in sc.plan.crashes.items():
-            if idx < sc.n_forkers:
+            if idx in byz:
                 raise ValueError("crash windows must name honest members")
             for down, up in windows:
                 # down >= 1 so the turn-0 checkpoint exists to restore from
@@ -126,8 +146,10 @@ class ChaosSimulation:
         self.clock = pop.clock
         self.transport: FaultyTransport = pop.transport
         self.forkers: List[DivergentForker] = []
+        self.adversary_drivers: List = []   # active drivers (adversary.py)
         # honest nodes indexed by MEMBER index (None while crashed)
         self.nodes: Dict[int, Optional[Node]] = {}
+        adversaries = sc.adversaries or {}
         for i, (pk, sk) in enumerate(self.keys):
             if i < sc.n_forkers:
                 f = DivergentForker(
@@ -138,6 +160,11 @@ class ChaosSimulation:
                 self.network[pk] = f.ask_sync
                 self.network_want[pk] = f.ask_events
                 self.forkers.append(f)
+            elif i in adversaries:
+                drv = adversaries[i](self, i)
+                self.network[pk] = drv.ask_sync
+                self.network_want[pk] = drv.ask_events
+                self.adversary_drivers.append(drv)
             else:
                 self.nodes[i] = self._make_node(i)
         self.on_turn = on_turn
@@ -274,6 +301,10 @@ class ChaosSimulation:
             if sc.n_forkers and turn % max(1, sc.fork_every) == 0:
                 for f in self.forkers:
                     f.step(honest_pks)
+            for drv in self.adversary_drivers:
+                step = getattr(drv, "step", None)
+                if step is not None:
+                    step(turn, honest_pks)
             if turn == self._heal_t:
                 self._decided_at_heal = self._min_decided()
             if self.on_turn is not None:
@@ -362,6 +393,16 @@ class ChaosSimulation:
                 "circuit_opens": sum(n.circuit_opens for n in nodes),
                 "quarantined_member_indices": quarantined,
                 "forks_detected": max(n.forks_detected for n in nodes),
+                "equivocations_detected": max(
+                    n.equivocations_detected for n in nodes
+                ),
+                "withholding_suspected": sum(
+                    n.withholding_suspected for n in nodes
+                ),
+                "budget_exhausted": max(n.budget_exhausted for n in nodes),
+                "sync_branches_capped": sum(
+                    n.sync_branches_capped for n in nodes
+                ),
                 "orphans_parked": sum(n.orphans_parked for n in nodes),
                 "late_witnesses": sum(
                     len(n.late_witnesses) for n in nodes
@@ -376,6 +417,8 @@ class ChaosSimulation:
                 "n_nodes": self.scenario.n_nodes,
                 "n_turns": self.scenario.n_turns,
                 "n_forkers": self.scenario.n_forkers,
+                "adversary_indices": sorted(self.scenario.adversaries or ()),
+                "attack_end": self.scenario.attack_end,
             },
         }
 

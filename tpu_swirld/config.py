@@ -108,6 +108,11 @@ class SwirldConfig:
     max_reply_events: int = 65536   # server-side cap on events per reply
     quarantine_forkers: bool = False  # detected equivocators trip the
                                       # circuit breaker immediately
+    max_fork_branches: int = 8   # sync-reply amplification bound: branch
+                                 # tails walked per forked creator per
+                                 # reply (deterministic sorted selection;
+                                 # the earliest fork-group proof always
+                                 # ships, residue recovers via want-lists)
 
     # --- slab archive / background spill pipeline (store.archive) ---
     # None = fall back to SWIRLD_ARCHIVE_* env var, then built-in default

@@ -118,6 +118,10 @@ def node_gauges(
         "late_witnesses": len(getattr(node, "late_witnesses", ())),
         "horizon_violations": getattr(node, "horizon_violations", 0),
         "forks_detected": getattr(node, "forks_detected", 0),
+        "equivocations_detected": getattr(node, "equivocations_detected", 0),
+        "withholding_suspected": getattr(node, "withholding_suspected", 0),
+        "budget_exhausted": getattr(node, "budget_exhausted", 0),
+        "sync_branches_capped": getattr(node, "sync_branches_capped", 0),
         "bad_replies": getattr(node, "bad_replies", 0),
         "bad_requests": getattr(node, "bad_requests", 0),
         "retries": getattr(node, "retries", 0),

@@ -66,16 +66,23 @@ def gauge_rows(events: List[Dict]) -> List[Dict]:
 
 # The chaos/resilience failure surface gets its own report section so a
 # fault-injected run's health reads at a glance: transport fault counters
-# (FaultyTransport), retry/backoff/circuit-breaker counters (Node), and
-# the incremental driver's storm-guard decision gauges.
+# (FaultyTransport), retry/backoff/circuit-breaker counters (Node), the
+# adversary-detection counters (equivocation / withholding / 3f budget),
+# and the incremental driver's storm-guard decision gauges.
 _RESILIENCE_PREFIXES = (
     "transport_",
+    "adversary_",
+    "node_equivocations",
+    "node_withholding",
+    "node_budget_exhausted",
+    "node_sync_branches_capped",
     "gossip_transport_errors",
     "gossip_retries",
     "gossip_backoff",
     "gossip_deadline",
     "gossip_circuit",
     "gossip_bad_",
+    "gossip_sync_branches_capped",
     "incremental_storm",
     "incremental_consecutive_rebases",
     "consensus_late_witnesses",

@@ -128,6 +128,17 @@ class Node:
         self.bad_requests = 0  # malformed requests served an empty reply
         self.retries = 0       # transport retry attempts issued
         self.backoff_total = 0.0  # cumulative backoff (logical ticks)
+        # --- adversary detection counters (wired into node_gauges and the
+        # report CLI's resilience section as adversary_*) ---
+        self.equivocations_detected = 0   # fork groups seen (one per
+                                          # detected (creator, seq) pair)
+        self.withholding_suspected = 0    # pulls where a peer provably
+                                          # held a parent it refused to
+                                          # serve (see pull's want loop)
+        self.budget_exhausted = 0         # forked creators beyond the
+                                          # f = (n-1)//3 admission budget
+        self.sync_branches_capped = 0     # ask_sync replies whose branch
+                                          # walk hit max_fork_branches
         self.metrics = None   # set to metrics.Metrics() to enable counters
         self.tracer = None    # set to obs.Tracer() to record phase spans
         self._tpu_engine = None   # lazily built when config.backend == "tpu"
@@ -322,10 +333,13 @@ class Node:
         group.append(eid)
         if len(group) == 2:
             # first fork at this (creator, seq)
+            newly_forked = not self.has_fork[c]
             self.fork_groups[c][s] = group
             self.has_fork[c] = True
+            self.equivocations_detected += 1
             if self.metrics is not None:
                 self.metrics.count("gossip_fork_pairs_detected")
+                self.metrics.count("adversary_equivocations_detected")
             if (
                 self.config.quarantine_forkers
                 and self.breaker is not None
@@ -337,6 +351,23 @@ class Node:
                 self.breaker.record_misbehavior(
                     c, weight=self.breaker.misbehavior_threshold
                 )
+            if newly_forked:
+                # explicit n > 3f admission check: the vote structure only
+                # tolerates f = (n-1)//3 equivocating creators.  Events
+                # beyond the budget are still admitted (fork PROOFS must
+                # keep flowing so every engine's fork ledger agrees), but
+                # the violation is surfaced — never silently absorbed —
+                # and the over-budget creator is cut off at the breaker
+                # even when quarantine_forkers is off.
+                f_budget = (len(self.members) - 1) // 3
+                if self.forks_detected > f_budget:
+                    self.budget_exhausted += 1
+                    if self.metrics is not None:
+                        self.metrics.count("adversary_budget_exhausted")
+                    if self.breaker is not None and c != self.pk:
+                        self.breaker.record_misbehavior(
+                            c, weight=self.breaker.misbehavior_threshold
+                        )
         if not self.has_fork[c]:
             self.member_chain[c].append(eid)   # index == seq while honest
         if c == self.pk:
@@ -500,7 +531,21 @@ class Node:
             # per reply instead of the old O(full history).
             miss = max(len(known) - heights[m], 0)
             extra: set = set()
-            for tip in sorted(self.branch_tips[m]):
+            # amplification bound: an equivocation storm can mint one
+            # live branch per fork pair, making the tail walk — and the
+            # reply — O(branches * delta) with unbounded branches.  Cap
+            # the branches walked per reply (deterministic sorted
+            # selection so every peer sees the same digest); the earliest
+            # fork-group proof below always ships, and events on skipped
+            # branches surface as orphan want-lists over later syncs.
+            tips = sorted(self.branch_tips[m])
+            cap = max(1, self.config.max_fork_branches)
+            if len(tips) > cap:
+                self.sync_branches_capped += 1
+                if self.metrics is not None:
+                    self.metrics.count("gossip_sync_branches_capped")
+                tips = tips[:cap]
+            for tip in tips:
                 cur: Optional[bytes] = tip
                 for _ in range(miss + 1):
                     if cur is None or cur in extra:
@@ -823,6 +868,13 @@ class Node:
             br.record_success(peer_pk)
         if met is not None:
             met.count("gossip_bytes_in", len(reply))
+        # parents referenced by events THIS peer served us this pull: the
+        # peer's own store admitted those events, so it provably held the
+        # parents too (add_event requires both parents present) — the
+        # evidence base for the withholding heuristic below
+        served_parents: set = set()
+        for ev in events:
+            served_parents.update(ev.p)
         self._ingest(events, new_ids)
         # want-list recovery: bounded by DAG depth, capped defensively
         has_want = self.transport.endpoint(peer_pk, CHANNEL_WANT) is not None
@@ -843,8 +895,29 @@ class Node:
                 break
             if met is not None:
                 met.count("gossip_bytes_in", len(wreply))
+            # withholding detection: a validly-signed want reply that
+            # omits a parent of an event the SAME peer served us this
+            # pull is (near-)proof of selective censorship — the peer
+            # demonstrably held that parent when it admitted the child.
+            # "Suspected", not proven: an in-flight-corrupted want
+            # request is answered with a signed empty reply, which looks
+            # identical here — hence a mild breaker strike, not the full
+            # equivocation escalation.
+            got_ids = {ev.id for ev in got}
+            withheld = [
+                w for w in want
+                if w not in got_ids and w in served_parents
+            ]
+            if withheld:
+                self.withholding_suspected += 1
+                if met is not None:
+                    met.count("adversary_withholding_suspected")
+                if br is not None:
+                    br.record_misbehavior(peer_pk)
             if not got:
                 break
+            for ev in got:
+                served_parents.update(ev.p)
             before = len(new_ids) + len(self._orphans)
             self._ingest(got, new_ids)
             if len(new_ids) + len(self._orphans) == before:

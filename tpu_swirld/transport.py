@@ -32,8 +32,11 @@ sim.Simulation` and :class:`~tpu_swirld.oracle.node.Node`:
   the node's fork-detection bookkeeping when
   ``config.quarantine_forkers`` is set).
 
-Every fault is drawn from one ``random.Random(plan.seed)`` stream, so a
-chaos run is reproducible from ``(population seed, plan seed)`` alone.
+Every fault is drawn from a per-directed-link RNG stream derived from
+``plan.seed`` via ``numpy.random.SeedSequence`` spawn keys — hash-stable
+and independent of the order links first carry traffic — so a chaos run
+is reproducible from ``(population seed, plan seed)`` alone and a link's
+fault history is a pure function of ``(plan.seed, src, dst, call#)``.
 """
 
 from __future__ import annotations
@@ -42,6 +45,8 @@ import collections
 import dataclasses
 import random
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 from tpu_swirld import obs
 
@@ -188,7 +193,12 @@ class FaultyTransport(Transport):
 
     ``clock`` supplies logical time (the sim's turn counter) for
     partition windows; ``members`` resolves pk -> index for the plan's
-    index-keyed knobs.  All randomness comes from ``Random(plan.seed)``.
+    index-keyed knobs.  Each directed link draws from its own RNG stream,
+    keyed ``SeedSequence(plan.seed, spawn_key=(src_i+1, dst_i+1))`` — the
+    hash-stable spawn construction, so a link's fault sequence never
+    depends on which other links happened to carry traffic first (the old
+    single shared ``Random(plan.seed)`` made every link's draws a
+    function of global call interleaving).
 
     Fault counters accumulate in :attr:`stats` and, when an ambient
     :func:`tpu_swirld.obs.current` registry is enabled, as
@@ -209,11 +219,25 @@ class FaultyTransport(Transport):
         self.clock = clock
         self.member_index = {m: i for i, m in enumerate(members)}
         self.down: set = set()          # crashed pks (driver-maintained)
-        self._rng = random.Random(plan.seed)
+        self._link_rngs: Dict[Tuple[int, int], np.random.Generator] = {}
         self._pending: Dict[Tuple[bytes, bytes, str], collections.deque] = {}
         self.stats: Dict[str, int] = collections.defaultdict(int)
 
     # ------------------------------------------------------------- helpers
+
+    def _link_rng(self, src_i: int, dst_i: int) -> np.random.Generator:
+        """The directed link's private fault stream.  Spawn keys are
+        offset by 1 so unknown members (index -1) get a valid stream."""
+        key = (src_i, dst_i)
+        rng = self._link_rngs.get(key)
+        if rng is None:
+            rng = np.random.default_rng(
+                np.random.SeedSequence(
+                    self.plan.seed, spawn_key=(src_i + 1, dst_i + 1)
+                )
+            )
+            self._link_rngs[key] = rng
+        return rng
 
     def _count(self, name: str, delta: int = 1) -> None:
         self.stats[name] += delta
@@ -221,15 +245,15 @@ class FaultyTransport(Transport):
         if o is not None:
             o.registry.counter(f"transport_{name}_total").inc(delta)
 
-    def _corrupt(self, data: bytes) -> bytes:
+    @staticmethod
+    def _corrupt(data: bytes, r: np.random.Generator) -> bytes:
         """Truncate, bit-flip, or empty the message — never crash."""
-        r = self._rng
-        mode = r.randrange(3)
+        mode = int(r.integers(3))
         if not data or mode == 0:
-            return data[: r.randrange(len(data) + 1)]     # truncation
+            return data[: int(r.integers(len(data) + 1))]  # truncation
         if mode == 1:
-            i = r.randrange(len(data))
-            return data[:i] + bytes([data[i] ^ (1 << r.randrange(8))]) + data[i + 1:]
+            i = int(r.integers(len(data)))
+            return data[:i] + bytes([data[i] ^ (1 << int(r.integers(8)))]) + data[i + 1:]
         return b""                                         # total garbage
 
     def set_down(self, pk: bytes) -> None:
@@ -251,14 +275,14 @@ class FaultyTransport(Transport):
             self._count("partition_blocked")
             raise PeerPartitioned(f"link cut at t={t}")
         lf = self.plan.faults_for(si, di)
-        r = self._rng
+        r = self._link_rng(si, di)
         if r.random() < lf.drop:
             self._count("drops")
             raise MessageDropped("request lost")
         req = payload
         if r.random() < lf.corrupt:
             self._count("corruptions")
-            req = self._corrupt(req)
+            req = self._corrupt(req, r)
         try:
             reply = super().call(src, dst, channel, req)
         except TransportError:
@@ -273,7 +297,7 @@ class FaultyTransport(Transport):
             raise MessageDropped("reply lost")
         if r.random() < lf.corrupt:
             self._count("corruptions")
-            reply = self._corrupt(reply)
+            reply = self._corrupt(reply, r)
         key = (src, dst, channel)
         queue = self._pending.setdefault(key, collections.deque(maxlen=8))
         if r.random() < lf.duplicate:
