@@ -104,6 +104,21 @@ def lint_stamp():
         return {"error": repr(exc)}
 
 
+def mc_stamp():
+    """Model-checker smoke verdict stamped into the artifact: the small
+    vanilla world explored exhaustively (every invariant over every
+    interleaving) with the POR+symmetry reduction ratio vs the naive
+    baseline.  bench_compare.py refuses to gate a candidate whose stamp
+    is dirty — a throughput number from a tree whose consensus core
+    violates its own invariant catalog is not comparable."""
+    try:
+        from tpu_swirld.analysis.mc import mc_smoke
+
+        return mc_smoke()
+    except Exception as exc:   # the stamp must never sink a bench run
+        return {"error": repr(exc)}
+
+
 def probe_tpu() -> bool:
     """Can the default (axon/TPU) backend initialize? Probe in a child
     process under a hard timeout so a wedged PJRT init can't hang us.
@@ -326,6 +341,7 @@ def run_default():
     if inc_out is not None:
         out["incremental"] = inc_out
     out["lint"] = lint_stamp()
+    out["mc"] = mc_stamp()
     print(json.dumps(out), flush=True)
     mon.close()
     if not parity or (inc_out is not None and not inc_out["parity"]):
@@ -571,6 +587,7 @@ def run_stream(tile_budget, tile, mesh_n=0, device_tile_budget=None):
             f"mesh-streaming ({mesh_n} dev) events/sec",
         )
     out["lint"] = lint_stamp()
+    out["mc"] = mc_stamp()
     print(json.dumps(out), flush=True)
     mon.close()
     if not parity or not budget_ok or not dev_budget_ok:
@@ -652,6 +669,7 @@ def run_chaos_overhead():
             "overflow_retries": legs["attack"]["overflow_retries"],
         },
         "lint": lint_stamp(),
+        "mc": mc_stamp(),
     }
     print(json.dumps(out), flush=True)
 

@@ -107,6 +107,25 @@ def lint_gate(new: Dict) -> Optional[str]:
     )
 
 
+def mc_gate(new: Dict) -> Optional[str]:
+    """Refuse to gate a candidate whose model-checker smoke stamp is
+    dirty.  bench.py stamps ``mc`` (``tpu_swirld.analysis.mc``
+    ``mc_smoke``: the small world explored exhaustively under the full
+    invariant catalog) into every artifact; a stamp that is not ``ok``
+    means the consensus core the bench exercised violates its own
+    invariants, so the number is not comparable.  Artifacts predating
+    the stamp pass with a note — the gate only hardens going forward."""
+    mc = new.get("mc")
+    if mc is None:
+        return None
+    if isinstance(mc, dict) and mc.get("ok"):
+        return None
+    return (
+        f"candidate tree failed the model-checker smoke ({mc!r}); run "
+        "python -m tpu_swirld.analysis mc, fix, and re-bench before gating"
+    )
+
+
 def compare(old: Dict, new: Dict, key: str, threshold: float):
     """Returns (failures, report_lines)."""
     lines = []
@@ -149,13 +168,16 @@ def main(argv=None) -> int:
         old = unwrap(json.load(f))
     with open(args.new) as f:
         new = unwrap(json.load(f))
-    gate = lint_gate(new)
-    if gate is not None:
-        print(f"\nFAIL: {gate}", file=sys.stderr)
-        return 1
+    for gate in (lint_gate(new), mc_gate(new)):
+        if gate is not None:
+            print(f"\nFAIL: {gate}", file=sys.stderr)
+            return 1
     if new.get("lint") is None:
         print("note: candidate carries no lint stamp (pre-analysis "
               "artifact); gating on metrics only", file=sys.stderr)
+    if new.get("mc") is None:
+        print("note: candidate carries no model-checker stamp "
+              "(pre-mc artifact); gating on metrics only", file=sys.stderr)
     failures, lines = compare(old, new, args.key, args.threshold)
     for ln in lines:
         print(ln)

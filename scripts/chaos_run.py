@@ -166,6 +166,63 @@ def run_sanitized(args, base_verdict: dict) -> dict:
     }
 
 
+def run_mc_section(args) -> dict:
+    """--mc: fold a model-checker section into the verdict, three legs:
+
+    - *smoke*: exhaustive BFS of the small vanilla world (every invariant
+      must hold over the whole space, the POR+symmetry reduction must
+      beat the naive baseline);
+    - *mutation*: one seeded bug (``fork-blind``) must be caught by its
+      expected invariant with a minimized, bit-deterministically
+      replaying counterexample — the checker's own end-to-end proof;
+    - *parity*: a clean replayable schedule document round-trips through
+      :func:`tpu_swirld.chaos.replay_counterexample`, which gates the
+      final state on cross-engine ``_engines_agree`` rows under the
+      same ``--engine`` the acceptance scenario used.
+    """
+    from tpu_swirld import crypto
+    from tpu_swirld.analysis.mc import counterexample as ce
+    from tpu_swirld.analysis.mc.cli import mc_smoke, run_mc
+    from tpu_swirld.analysis.mc.world import World
+    from tpu_swirld.chaos import replay_counterexample
+
+    smoke = mc_smoke()
+    mut = run_mc(mutate="fork-blind")
+    cex = mut.get("counterexample") or {}
+    mutation = {
+        "name": "fork-blind",
+        "caught_expected": bool(cex.get("caught_expected")),
+        "minimized_len": cex.get("minimized_len"),
+        "replay_ok": bool(
+            cex.get("replay_reproduced")
+            and cex.get("replay_digests_match")
+            and cex.get("replay_trace_match")
+        ),
+    }
+    prev = crypto.backend_name()
+    crypto.set_backend("sim")
+    try:
+        w = World(n_honest=3, n_forkers=0, events=3, seed=args.seed or 0)
+        sched = [
+            ("sync", 1, 0), ("sync", 0, 1), ("sync", 2, 0),
+            ("pull", 0, 2), ("pull", 1, 2),
+        ]
+        doc = ce.emit(w, sched, ce.run_checked(w, sched))
+    finally:
+        crypto.set_backend(prev)
+    parity = replay_counterexample(doc, engine=args.engine)
+    parity.pop("violation", None)
+    return {
+        "smoke": smoke,
+        "mutation": mutation,
+        "parity": parity,
+        "ok": bool(
+            smoke["ok"] and mutation["caught_expected"]
+            and mutation["replay_ok"] and parity["ok"]
+        ),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument(
@@ -223,6 +280,14 @@ def main(argv=None) -> int:
         "'sanitizer' section into the verdict and fails it on any "
         "schedule-dependent outcome (default N=4; multiplies runtime)",
     )
+    ap.add_argument(
+        "--mc", action="store_true",
+        help="fold a model-checker section into the verdict: exhaustive "
+        "smoke world (all invariants over every interleaving, reduction "
+        "ratio vs naive), one seeded-bug mutation with a minimized "
+        "replaying counterexample, and a clean replayable schedule "
+        "document gated on cross-engine parity under --engine",
+    )
     ap.add_argument("--out", default="chaos_verdict.json")
     args = ap.parse_args(argv)
 
@@ -242,6 +307,8 @@ def main(argv=None) -> int:
     if args.all:
         if args.sanitize:
             ap.error("--all and --sanitize are mutually exclusive")
+        if args.mc:
+            ap.error("--all and --mc are mutually exclusive")
         results = {}
         for name in RUNNERS:
             sub = argparse.Namespace(**{**vars(args), "scenario": name})
@@ -280,10 +347,13 @@ def main(argv=None) -> int:
     if args.sanitize:
         verdict["sanitizer"] = run_sanitized(args, verdict)
         verdict["ok"] = bool(verdict["ok"] and verdict["sanitizer"]["ok"])
+    if args.mc:
+        verdict["mc"] = run_mc_section(args)
+        verdict["ok"] = bool(verdict["ok"] and verdict["mc"]["ok"])
     with open(args.out, "w") as f:
         json.dump(verdict, f, indent=2, sort_keys=True)
     for key in ("safety", "liveness", "horizon", "fork_storm", "round_clamp",
-                "adversary", "engines", "sanitizer"):
+                "adversary", "engines", "sanitizer", "mc"):
         if key in verdict:
             print(json.dumps({key: verdict[key]}, sort_keys=True))
     print(f"verdict: {'OK' if verdict['ok'] else 'FAIL'} -> {args.out}")

@@ -195,6 +195,21 @@ class W:
     assert check_source(fixed) == []
 
 
+def test_sw007_load_bearing_assert_scoped():
+    bad = "def f(x):\n    assert x > 0, 'positive'\n    return x\n"
+    assert "SW007" in _rules(check_source(bad, module_path="oracle/node.py"))
+    assert "SW007" in _rules(check_source(bad, module_path="tpu/pipeline.py"))
+    # tests/benches keep their asserts — out of the production scope
+    assert check_source(bad, module_path="sim.py") == []
+    fixed = (
+        "def f(x):\n"
+        "    if not x > 0:\n"
+        "        raise ValueError('positive')\n"
+        "    return x\n"
+    )
+    assert check_source(fixed, module_path="oracle/node.py") == []
+
+
 def test_suppression_comment():
     bad = (
         "s = set()\n"
@@ -279,9 +294,24 @@ def test_jit_audit_zero_steady_recompiles():
     zero jit-cache entries and every stage keeps a drift-free abstract
     signature (a weak_type flip would recompile at identical shapes)."""
     r = jit_audit.runtime_audit()
+    assert r["engine"] == "incremental"
     assert r["steady_compiles"] == {}, r
     assert r["signature_drift"] == [], r
     assert r["ok"] and r["stages_observed"]
+
+
+def test_jit_audit_rejects_unknown_engine():
+    with pytest.raises(ValueError, match="unknown engine"):
+        jit_audit.runtime_audit(engine="warp")
+
+
+@pytest.mark.slow
+def test_jit_audit_streaming_engine():
+    """--engine streaming: the slab-store retire/fetch stages join the
+    audited set and the steady window stays recompile- and drift-free."""
+    r = jit_audit.runtime_audit(engine="streaming")
+    assert r["engine"] == "streaming"
+    assert r["ok"], r
 
 
 def test_archive_schedule_fuzz_32():
@@ -429,3 +459,41 @@ def test_bench_lint_stamp_shape():
     finally:
         sys.path.remove(_ROOT)
     assert stamp == {"findings": 0, "clean": True, "by_rule": {}}
+
+
+def test_bench_compare_refuses_dirty_mc(tmp_path):
+    """bench_compare.py: a candidate whose model-checker smoke stamp is
+    dirty is not gated; a clean stamp and a stamp-less artifact are."""
+    mod = _load_script("bench_compare")
+    old = tmp_path / "old.json"
+    old.write_text(json.dumps({"value": 100.0}))
+
+    dirty = tmp_path / "dirty.json"
+    dirty.write_text(json.dumps({
+        "value": 120.0,
+        "mc": {"ok": False, "violations": 1, "exhaustive": True},
+    }))
+    assert mod.main([str(old), str(dirty)]) == 1
+
+    clean = tmp_path / "clean.json"
+    clean.write_text(json.dumps({
+        "value": 101.0,
+        "mc": {"ok": True, "violations": 0, "exhaustive": True},
+    }))
+    assert mod.main([str(old), str(clean)]) == 0
+    # pre-mc artifacts gate on metrics alone
+    assert mod.main([str(old), str(old)]) == 0
+
+
+def test_bench_mc_stamp_shape():
+    """bench.py's model-checker stamp: the exhaustive smoke world is
+    clean on this tree and carries the ratio bench_compare reports."""
+    sys.path.insert(0, _ROOT)
+    try:
+        import bench
+        stamp = bench.mc_stamp()
+    finally:
+        sys.path.remove(_ROOT)
+    assert stamp["ok"], stamp
+    assert stamp["exhaustive"] and stamp["violations"] == 0
+    assert stamp["state_ratio"] > 2

@@ -1,0 +1,180 @@
+# Copyright 2026 tpu-swirld authors.
+"""Explicit-state model checker suite (``-m mc``).
+
+Tier-1 tier: the exhaustive smoke world (n=3, events=2) explored clean
+with a >2x partial-order/symmetry reduction, determinism of the
+exploration itself, the POR state-coverage proof (reduced exploration
+visits the SAME state set as the naive baseline, just over fewer
+transitions), every seeded mutation caught by its expected invariant
+with a minimized counterexample that replays bit-identically, the
+counterexample JSON round-trip through the chaos harness, and the CLI
+exit-code contract.
+
+``-m slow`` tier: the events=3 exhaustive configs (vanilla and forker
+worlds) — minutes, not seconds.
+"""
+
+import json
+
+import pytest
+
+from tpu_swirld import crypto
+from tpu_swirld.analysis.mc import (
+    INVARIANTS, MUTATIONS, explore, make_world, mc_smoke, run_mc,
+)
+from tpu_swirld.analysis.mc import counterexample as ce
+from tpu_swirld.analysis.mc.cli import main as mc_main
+from tpu_swirld.chaos import replay_counterexample
+
+pytestmark = pytest.mark.mc
+
+
+@pytest.fixture()
+def sim_backend():
+    """Force the deterministic sim crypto backend for tests that drive
+    ``World``/``explore`` directly (``run_mc`` scopes it internally)."""
+    prev = crypto.backend_name()
+    crypto.set_backend("sim")
+    yield
+    crypto.set_backend(prev)
+
+
+# ------------------------------------------------------------ exhaustive
+
+
+def test_smoke_world_explores_clean_with_reduction():
+    rep = mc_smoke()           # n=3, events=2, with the naive baseline
+    assert rep["ok"]
+    assert rep["exhaustive"]
+    assert rep["violations"] == 0
+    assert rep["states"] > 1000          # non-trivial space
+    # ISSUE acceptance: POR + symmetry shrink the space by >2x
+    assert rep["state_ratio"] > 2
+    assert rep["transition_ratio"] > 2
+
+
+def test_exploration_is_deterministic(sim_backend):
+    runs = [
+        explore(make_world(None, n_honest=3, n_forkers=0, events=2))
+        for _ in range(2)
+    ]
+    assert runs[0].to_dict() == runs[1].to_dict()
+    assert runs[0].exhaustive and runs[0].violation is None
+
+
+def test_por_preserves_state_coverage(sim_backend):
+    """Sleep-set POR is sound: it prunes redundant *transitions*, never
+    states — the reduced run must visit exactly the naive state count.
+    Symmetry (honest-member relabeling) is what shrinks the state set."""
+    kw = dict(n_honest=3, n_forkers=0, events=2)
+    naive = explore(make_world(None, **kw), por=False, symmetry=False,
+                    check_invariants=False)
+    por_only = explore(make_world(None, **kw), por=True, symmetry=False,
+                       check_invariants=False)
+    reduced = explore(make_world(None, **kw), por=True, symmetry=True,
+                      check_invariants=False)
+    assert naive.exhaustive and por_only.exhaustive and reduced.exhaustive
+    assert por_only.states == naive.states
+    assert por_only.transitions < naive.transitions
+    assert reduced.states < por_only.states
+
+
+# ------------------------------------------------------------- mutations
+
+
+@pytest.mark.parametrize("name", sorted(MUTATIONS))
+def test_mutation_caught_with_minimized_replayable_witness(name):
+    rep = run_mc(mutate=name, compare=False)
+    cex = rep.get("counterexample")
+    assert cex is not None, f"mutation {name} produced no violation"
+    assert cex["caught_expected"], (
+        f"{name}: expected {MUTATIONS[name].expected_invariant}, "
+        f"got {cex['violation']['invariant']}"
+    )
+    assert cex["minimized_len"] <= cex["schedule_len"]
+    # the minimized document replays bit-deterministically
+    assert cex["replay_reproduced"]
+    assert cex["replay_digests_match"]
+    assert cex["replay_trace_match"]
+
+
+def test_counterexample_doc_roundtrip(tmp_path):
+    out = tmp_path / "ce.json"
+    rep = run_mc(mutate="fork-blind", compare=False, out=str(out))
+    doc = json.loads(out.read_text())
+    assert doc["kind"] == "mc-counterexample"
+    assert doc["world"]["mutate"] == "fork-blind"
+    assert doc["violation"]["invariant"] == "fork-budget"
+    assert doc["schedule"] == [
+        list(a) for a in rep["counterexample"]["document"]["schedule"]
+    ]
+    # chaos-harness ingestion: replay fidelity gates ok for mutated docs
+    chaos_rep = replay_counterexample(str(out))
+    assert chaos_rep["kind"] == "mc-replay"
+    assert chaos_rep["reproduced"] and chaos_rep["digests_match"]
+    assert chaos_rep["ok"]
+
+
+def test_clean_schedule_doc_parity_probe(sim_backend):
+    """A violation-free document is a clean replayable schedule: replay
+    asserts it STAYS clean, and the chaos harness adds the cross-engine
+    parity rows on the replayed hashgraph."""
+    world = make_world(None, n_honest=3, n_forkers=0, events=3)
+    schedule = [
+        ("sync", 1, 0), ("sync", 0, 1), ("sync", 2, 0),
+        ("pull", 0, 2), ("pull", 1, 2),
+    ]
+    report = ce.run_checked(world, schedule)
+    assert report["violation"] is None
+    doc = ce.emit(world, schedule, report)
+    assert doc["violation"] is None
+    rep = replay_counterexample(doc)
+    assert rep["violation"] is None
+    assert rep["reproduced"] and rep["digests_match"] and rep["trace_match"]
+    assert rep["ok"]
+
+
+# ------------------------------------------------------------------- CLI
+
+
+def test_cli_exit_codes(tmp_path):
+    # clean exhaustive run -> 0
+    assert mc_main(["--events", "1", "--no-compare"]) == 0
+    # mutation run finds its expected violation -> 1, and saves the doc
+    out = tmp_path / "cli_ce.json"
+    assert mc_main(["--mutate", "fork-blind", "--out", str(out)]) == 1
+    assert json.loads(out.read_text())["violation"]["invariant"] == (
+        "fork-budget"
+    )
+    # state cap hit before exhaustion -> 2 (nothing proven)
+    assert mc_main(
+        ["--events", "2", "--max-states", "50", "--no-compare"]
+    ) == 2
+    # unknown mutation is an argparse error
+    with pytest.raises(SystemExit):
+        mc_main(["--mutate", "no-such-bug"])
+
+
+def test_catalog_is_well_formed():
+    ids = [inv.id for inv in INVARIANTS]
+    assert len(ids) == len(set(ids))
+    assert {m.expected_invariant for m in MUTATIONS.values()} <= set(ids)
+    assert all(inv.kind in ("state", "edge") for inv in INVARIANTS)
+
+
+# -------------------------------------------------------------- slow tier
+
+
+@pytest.mark.slow
+def test_exhaustive_events3_vanilla():
+    rep = run_mc(events=3, compare=False)
+    assert rep["explore"]["exhaustive"]
+    assert rep["explore"]["violations_found"] == 0
+    assert rep["explore"]["states"] > 20_000
+
+
+@pytest.mark.slow
+def test_exhaustive_events3_forker():
+    rep = run_mc(n=2, forkers=1, events=3, compare=False)
+    assert rep["explore"]["exhaustive"]
+    assert rep["explore"]["violations_found"] == 0

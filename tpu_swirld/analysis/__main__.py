@@ -3,11 +3,12 @@
 Subcommands::
 
     lint      [paths...] [--json] [--rules ...] [--list-rules]
-    jit-audit [--static-only] [--members N] [--events N] [--json]
+    jit-audit [--static-only] [--members N] [--events N] [--engine E] [--json]
     races     [--schedules N] [--seed S] [--rows N] [--json]
+    mc        [--n N] [--events N] [--forkers N] [--mutate NAME] [--json]
 
-Each exits non-zero on findings / audit failures / schedule divergence,
-so all three slot directly into CI.
+Each exits non-zero on findings / audit failures / schedule divergence /
+invariant violations, so all four slot directly into CI.
 """
 
 from __future__ import annotations
@@ -27,8 +28,10 @@ def main(argv=None) -> int:
         from tpu_swirld.analysis.jit_audit import main as m
     elif cmd == "races":
         from tpu_swirld.analysis.races import main as m
+    elif cmd == "mc":
+        from tpu_swirld.analysis.mc.cli import main as m
     else:
-        print(f"unknown subcommand {cmd!r} (lint | jit-audit | races)")
+        print(f"unknown subcommand {cmd!r} (lint | jit-audit | races | mc)")
         return 2
     return m(rest)
 

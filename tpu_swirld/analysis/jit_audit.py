@@ -12,8 +12,11 @@ three:
   ``.block_until_ready()``, ``jax.device_get``, ``float()/int()/bool()``
   on tracers, and ``np.asarray``/``np.array`` (a silent device→host
   pull).
-- :func:`runtime_audit` — drives a real :class:`IncrementalConsensus`
-  over a generated gossip DAG with a signature observer installed on
+- :func:`runtime_audit` — drives a real windowed driver (``--engine``:
+  :class:`IncrementalConsensus`, the slab-store
+  :class:`StreamingConsensus`, or the row-sharded
+  :class:`MeshStreamingConsensus` from the mesh streaming soak) over a
+  generated gossip DAG with a signature observer installed on
   ``obs.stage_call``, then reports per-stage steady-state compile counts
   (cross-checked against :func:`tpu_swirld.obs.compile_counts`) and
   abstract-value drift: stages called with the same shapes/statics but
@@ -170,24 +173,51 @@ def runtime_audit(
     chunk: int = 128,
     window_bucket: int = 512,
     prune_min: int = 128,
+    engine: str = "incremental",
 ) -> Dict[str, Any]:
-    """Drive a real incremental-consensus run with the stage observer
+    """Drive a real windowed-consensus run with the stage observer
     installed; report steady-state compile counts and signature drift.
+
+    ``engine`` picks the driver under audit: ``"incremental"``
+    (:class:`~tpu_swirld.tpu.pipeline.IncrementalConsensus`),
+    ``"streaming"`` (:class:`~tpu_swirld.store.streaming.
+    StreamingConsensus` — the slab-store retire/fetch stages join the
+    observed set), or ``"mesh"`` (:class:`~tpu_swirld.parallel.
+    MeshStreamingConsensus` — the row-sharded mesh driver from the
+    streaming soak, so halo-exchange and sharded widening stages are
+    covered; simulate devices with
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8``).
 
     Warmup covers the first two thirds of the chunks (shape buckets fill
     there); the audit window is the remainder under a fresh ``Obs`` so
     ``compile_counts`` isolates steady-state recompiles, exactly like the
     tier-1 recompile regression."""
+    import functools
+
     from tpu_swirld import obs as obslib
     from tpu_swirld.config import SwirldConfig
     from tpu_swirld.sim import generate_gossip_dag
     from tpu_swirld.tpu.pipeline import IncrementalConsensus
 
+    if engine == "streaming":
+        from tpu_swirld.store.streaming import StreamingConsensus as _Driver
+    elif engine == "mesh":
+        import jax
+
+        from tpu_swirld.parallel import MeshStreamingConsensus, make_mesh
+
+        mesh = make_mesh(min(8, len(jax.devices())))
+        _Driver = functools.partial(MeshStreamingConsensus, mesh)
+    elif engine == "incremental":
+        _Driver = IncrementalConsensus
+    else:
+        raise ValueError(f"unknown engine {engine!r}")
+
     members, stake, events, _keys = generate_gossip_dag(
         n_members, n_events, seed=seed
     )
     cfg = SwirldConfig(n_members=n_members)
-    inc = IncrementalConsensus(
+    inc = _Driver(
         members, stake, cfg, chunk=chunk,
         window_bucket=window_bucket, prune_min=prune_min,
     )
@@ -213,6 +243,7 @@ def runtime_audit(
     steady = obslib.compile_counts(o.registry)
     drift = _find_drift(records)
     return {
+        "engine": engine,
         "stages_observed": sorted(records),
         "steady_calls": {k: len(v) for k, v in sorted(records.items())},
         "steady_compiles": steady,
@@ -234,6 +265,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--members", type=int, default=8)
     ap.add_argument("--events", type=int, default=1200)
     ap.add_argument("--seed", type=int, default=5)
+    ap.add_argument(
+        "--engine", choices=("incremental", "streaming", "mesh"),
+        default="incremental",
+        help="windowed driver for the runtime pass: incremental "
+        "(default), streaming (slab store), or mesh (row-sharded "
+        "MeshStreamingConsensus)",
+    )
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
@@ -241,7 +279,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ok = not report["static"]
     if not args.static_only:
         rt = runtime_audit(
-            n_members=args.members, n_events=args.events, seed=args.seed
+            n_members=args.members, n_events=args.events, seed=args.seed,
+            engine=args.engine,
         )
         report["runtime"] = rt
         ok = ok and rt["ok"]

@@ -26,6 +26,11 @@ Catalog:
 - **SW006 lock-discipline** — every ``self`` attribute a background
   worker thread touches must appear in the owning class's declared
   ``GUARDED_ATTRS`` frozenset.
+- **SW007 load-bearing-assert** — no ``assert`` statements in the
+  production modules (``oracle/``, ``store/``, ``tpu/``,
+  ``transport.py``, ``parallel.py``, ``packing.py``): asserts vanish
+  under ``python -O``; safety checks must be explicit raises (with a
+  counter where useful).
 """
 
 from __future__ import annotations
@@ -68,6 +73,7 @@ def all_rules() -> List[Rule]:
     from tpu_swirld.analysis.rules.determinism import (
         UnorderedIterRule, UnseededRngRule, WallClockRule,
     )
+    from tpu_swirld.analysis.rules.asserts import LoadBearingAssertRule
     from tpu_swirld.analysis.rules.donation import DonationRule
     from tpu_swirld.analysis.rules.dtype import DtypeRule
     from tpu_swirld.analysis.rules.locks import LockDisciplineRule
@@ -79,4 +85,5 @@ def all_rules() -> List[Rule]:
         DtypeRule(),
         DonationRule(),
         LockDisciplineRule(),
+        LoadBearingAssertRule(),
     ]

@@ -686,3 +686,53 @@ def run_overflow_storm(seed: int = 4) -> Dict:
         "round_clamp": clamp_leg,
         "scenario": {"seed": seed, "name": "overflow_storm"},
     }
+
+
+def replay_counterexample(doc_or_path, engine: str = "incremental") -> Dict:
+    """Ingest a model-checker counterexample document (the JSON emitted
+    by ``python -m tpu_swirld.analysis mc --out ...``) into the chaos
+    harness: replay the minimized schedule bit-deterministically through
+    the real node + transport seam, confirm the recorded violation and
+    per-node state digests reproduce exactly, and — for UNMUTATED
+    documents, where the consensus core is the shipping code — fold in a
+    cross-engine parity row (:func:`_engines_agree`) for the densest
+    honest node of the final state, tying the checker's explicit-state
+    worlds to the same oracle/device/streaming agreement bar every chaos
+    scenario is held to.  Mutated documents skip the parity probe (a
+    seeded bug is EXPECTED to diverge) and gate only on replay fidelity.
+    """
+    from tpu_swirld.analysis.mc import counterexample as _ce
+
+    doc = (
+        _ce.load(doc_or_path) if isinstance(doc_or_path, (str, os.PathLike))
+        else doc_or_path
+    )
+    rep = _ce.replay(doc)
+    out: Dict = {
+        "kind": "mc-replay",
+        "mutate": doc["world"].get("mutate"),
+        "schedule_len": len(doc["schedule"]),
+        "violation": doc.get("violation"),
+        "reproduced": rep["reproduced"],
+        "digests_match": rep["digests_match"],
+        "trace_match": rep["trace_match"],
+    }
+    ok = bool(rep["reproduced"] and rep["digests_match"] and rep["trace_match"])
+    if out["mutate"] is None:
+        world, nodes = rep["_world"], rep["_nodes"]
+        probe = max(
+            (nodes[r] for r in world.honest_roles), key=lambda n: len(n.hg)
+        )
+        try:
+            engines = _engines_agree(probe, engine=engine)
+        except Exception as exc:  # device path unavailable -> report, fail
+            engines = {"engine": engine, "error": repr(exc)}
+            ok = False
+        else:
+            ok = ok and bool(
+                engines["batch_oracle_parity"]
+                and engines["incremental_batch_parity"]
+            )
+        out["engines"] = engines
+    out["ok"] = ok
+    return out

@@ -333,47 +333,100 @@ class Node:
         group.append(eid)
         if len(group) == 2:
             # first fork at this (creator, seq)
-            newly_forked = not self.has_fork[c]
-            self.fork_groups[c][s] = group
-            self.has_fork[c] = True
-            self.equivocations_detected += 1
-            if self.metrics is not None:
-                self.metrics.count("gossip_fork_pairs_detected")
-                self.metrics.count("adversary_equivocations_detected")
-            if (
-                self.config.quarantine_forkers
-                and self.breaker is not None
-                and c != self.pk
-            ):
-                # fork detection feeds the breaker: a proven equivocator
-                # is quarantined outright (its events still arrive via
-                # honest relays; we just stop gossiping with it directly)
-                self.breaker.record_misbehavior(
-                    c, weight=self.breaker.misbehavior_threshold
-                )
-            if newly_forked:
-                # explicit n > 3f admission check: the vote structure only
-                # tolerates f = (n-1)//3 equivocating creators.  Events
-                # beyond the budget are still admitted (fork PROOFS must
-                # keep flowing so every engine's fork ledger agrees), but
-                # the violation is surfaced — never silently absorbed —
-                # and the over-budget creator is cut off at the breaker
-                # even when quarantine_forkers is off.
-                f_budget = (len(self.members) - 1) // 3
-                if self.forks_detected > f_budget:
-                    self.budget_exhausted += 1
-                    if self.metrics is not None:
-                        self.metrics.count("adversary_budget_exhausted")
-                    if self.breaker is not None and c != self.pk:
-                        self.breaker.record_misbehavior(
-                            c, weight=self.breaker.misbehavior_threshold
-                        )
+            self._on_fork_group(c, s, group)
         if not self.has_fork[c]:
             self.member_chain[c].append(eid)   # index == seq while honest
         if c == self.pk:
             self.head = eid
         self.tbd.append(eid)
         return True
+
+    def _on_fork_group(self, c: bytes, s: int, group: List[bytes]) -> None:
+        """Fork bookkeeping for the first pair at ``(creator, seq)``:
+        ledger entry, detection counters, breaker strikes, and the n > 3f
+        admission budget.  A dedicated seam so the model checker's
+        mutation mode (``analysis.mc.mutations``) can seed a fork-blind
+        bug here and prove the invariant catalog catches it."""
+        newly_forked = not self.has_fork[c]
+        self.fork_groups[c][s] = group
+        self.has_fork[c] = True
+        self.equivocations_detected += 1
+        if self.metrics is not None:
+            self.metrics.count("gossip_fork_pairs_detected")
+            self.metrics.count("adversary_equivocations_detected")
+        if (
+            self.config.quarantine_forkers
+            and self.breaker is not None
+            and c != self.pk
+        ):
+            # fork detection feeds the breaker: a proven equivocator
+            # is quarantined outright (its events still arrive via
+            # honest relays; we just stop gossiping with it directly)
+            self.breaker.record_misbehavior(
+                c, weight=self.breaker.misbehavior_threshold
+            )
+        if newly_forked:
+            self._check_fork_budget(c)
+
+    def _check_fork_budget(self, c: bytes) -> None:
+        """Explicit n > 3f admission check: the vote structure only
+        tolerates f = (n-1)//3 equivocating creators.  Events beyond the
+        budget are still admitted (fork PROOFS must keep flowing so every
+        engine's fork ledger agrees), but the violation is surfaced —
+        never silently absorbed — and the over-budget creator is cut off
+        at the breaker even when quarantine_forkers is off."""
+        f_budget = (len(self.members) - 1) // 3
+        if self.forks_detected > f_budget:
+            self.budget_exhausted += 1
+            if self.metrics is not None:
+                self.metrics.count("adversary_budget_exhausted")
+            if self.breaker is not None and c != self.pk:
+                self.breaker.record_misbehavior(
+                    c, weight=self.breaker.misbehavior_threshold
+                )
+
+    def state_digest(self) -> bytes:
+        """Canonical BLAKE2b digest of the consensus-relevant node state.
+
+        Covers the store (event ids), per-event round / witness / fame /
+        ordering assignments, the decided order, and the adversary
+        counters — everything the invariant catalog reasons about.  The
+        model checker (``analysis.mc``) uses it for counterexample
+        replay bit-determinism: a schedule replayed twice must land on
+        byte-identical digests at every step."""
+        parts: List[bytes] = [len(self.hg).to_bytes(4, "little")]
+        for eid in sorted(self.hg):
+            parts.append(eid)
+            parts.append(
+                self.round.get(eid, -1).to_bytes(4, "little", signed=True)
+            )
+            parts.append(b"\x01" if self.is_witness.get(eid) else b"\x00")
+            fam = self.famous.get(eid)
+            parts.append(
+                b"\x02" if fam is None else (b"\x01" if fam else b"\x00")
+            )
+            parts.append(
+                self.round_received.get(eid, -1).to_bytes(
+                    4, "little", signed=True
+                )
+            )
+            parts.append(
+                self.consensus_ts.get(eid, -1).to_bytes(8, "little", signed=True)
+            )
+        parts.append(len(self.consensus).to_bytes(4, "little"))
+        parts.extend(self.consensus)
+        for ctr in (
+            self.forks_detected,
+            self.equivocations_detected,
+            self.budget_exhausted,
+            len(self.late_witnesses),
+            self.horizon_violations,
+            self.bad_replies,
+            self.bad_requests,
+            self.withholding_suspected,
+        ):
+            parts.append(int(ctr).to_bytes(4, "little"))
+        return crypto.hash_bytes(b"".join(parts))
 
     # ------------------------------------------------------------ visibility
 
@@ -969,6 +1022,13 @@ class Node:
         self.famous[eid] = None
         self._next_vote_round[eid] = r + 1
 
+    def _parent_round(self, sp: bytes, op: bytes) -> int:
+        """Base round of a new event before witness promotion: the max of
+        its parents' rounds.  A seam for the model checker's round-skew
+        mutation (``analysis.mc.mutations``) — the round-monotonicity
+        invariant must catch any regression here."""
+        return max(self.round[sp], self.round[op])
+
     def divide_rounds(self, new_ids: Iterable[bytes]) -> None:
         """Assign round numbers and witness flags to ``new_ids`` (topo order).
 
@@ -981,7 +1041,7 @@ class Node:
                 self._register_witness(eid, 0)
                 continue
             sp, op = ev.p
-            r = max(self.round[sp], self.round[op])
+            r = self._parent_round(sp, op)
             # promotion: strongly-seen round-r witnesses, distinct creators
             amount = 0
             for c, wids in self.witnesses.get(r, {}).items():

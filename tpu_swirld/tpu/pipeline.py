@@ -122,6 +122,28 @@ def _bucket(v: int, m: int) -> int:
     return ((max(v, 1) + m - 1) // m) * m
 
 
+class ShapeContractError(ValueError):
+    """A device-kernel shape precondition was violated by the caller.
+
+    Raised explicitly (never ``assert`` — asserts vanish under
+    ``python -O``, silently disabling the guard in optimized
+    deployments; lint rule SW007) and counted in
+    :data:`shape_guard_trips` so harnesses can surface how often the
+    guard fired."""
+
+
+#: lifetime count of ShapeContractError raises in this process (a plain
+#: module counter: the guard is load-bearing, the count is observability)
+shape_guard_trips = 0
+
+
+def _shape_guard(ok: bool, message: str) -> None:
+    if not ok:
+        global shape_guard_trips
+        shape_guard_trips += 1
+        raise ShapeContractError(message)
+
+
 def _bmm(a: jnp.ndarray, b: jnp.ndarray, dtype) -> jnp.ndarray:
     """Boolean matmul: OR over products of 0/1 values (exact: f32 accum)."""
     return (
@@ -143,7 +165,10 @@ def ancestry(parents: jnp.ndarray, *, block: int, matmul_dtype) -> jnp.ndarray:
     with ``anc[i, j]`` = "j is an ancestor of i" (reflexive).
     """
     n = parents.shape[0]
-    assert n % block == 0, "pad N to a multiple of block"
+    _shape_guard(
+        n % block == 0,
+        f"ancestry: N={n} must be padded to a multiple of block={block}",
+    )
     n_blocks = n // block
     n_sq = max(1, math.ceil(math.log2(block)))
 

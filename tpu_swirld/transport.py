@@ -91,12 +91,21 @@ class Transport:
     ):
         self.network = network
         self.network_want = network_want if network_want is not None else {}
+        # Deterministic step hook: invoked as ``on_call(src, dst, channel)``
+        # for every delivery attempt that reaches an endpoint lookup.  The
+        # model checker (analysis.mc) installs a recorder here so a
+        # counterexample replay can prove, byte-for-byte, that the same
+        # schedule produces the same wire activity.  Must be a pure
+        # observer — raising or mutating node state here is undefined.
+        self.on_call: Optional[Callable[[bytes, bytes, str], None]] = None
 
     def endpoint(self, dst: bytes, channel: str) -> Optional[Callable]:
         table = self.network if channel == CHANNEL_SYNC else self.network_want
         return table.get(dst)
 
     def call(self, src: bytes, dst: bytes, channel: str, payload: bytes) -> bytes:
+        if self.on_call is not None:
+            self.on_call(src, dst, channel)
         fn = self.endpoint(dst, channel)
         if fn is None:
             raise PeerUnreachable(f"no {channel} endpoint for peer")
