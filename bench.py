@@ -119,6 +119,23 @@ def mc_stamp():
         return {"error": repr(exc)}
 
 
+def scale_audit_stamp():
+    """Scale-audit verdict of the tree this bench ran from, stamped into
+    the artifact: the jaxpr-level interval/dtype flow proof that no
+    int32 wraps, no gather/slice reads out of bounds, no narrowing
+    loses a value, and no padding sentinel collides with live data at
+    the baseline envelope.  bench_compare.py refuses to gate a
+    candidate whose stamp is dirty *or missing* — a throughput number
+    from kernels that are not provably safe at the declared scale is
+    not comparable."""
+    try:
+        from tpu_swirld.analysis import scale_audit_stamp as stamp
+
+        return stamp("baseline")
+    except Exception as exc:   # the stamp must never sink a bench run
+        return {"error": repr(exc)}
+
+
 def probe_tpu() -> bool:
     """Can the default (axon/TPU) backend initialize? Probe in a child
     process under a hard timeout so a wedged PJRT init can't hang us.
@@ -342,6 +359,7 @@ def run_default():
         out["incremental"] = inc_out
     out["lint"] = lint_stamp()
     out["mc"] = mc_stamp()
+    out["scale_audit"] = scale_audit_stamp()
     print(json.dumps(out), flush=True)
     mon.close()
     if not parity or (inc_out is not None and not inc_out["parity"]):
@@ -588,6 +606,7 @@ def run_stream(tile_budget, tile, mesh_n=0, device_tile_budget=None):
         )
     out["lint"] = lint_stamp()
     out["mc"] = mc_stamp()
+    out["scale_audit"] = scale_audit_stamp()
     print(json.dumps(out), flush=True)
     mon.close()
     if not parity or not budget_ok or not dev_budget_ok:
@@ -670,6 +689,7 @@ def run_chaos_overhead():
         },
         "lint": lint_stamp(),
         "mc": mc_stamp(),
+        "scale_audit": scale_audit_stamp(),
     }
     print(json.dumps(out), flush=True)
 

@@ -126,6 +126,31 @@ def mc_gate(new: Dict) -> Optional[str]:
     )
 
 
+def scale_audit_gate(new: Dict) -> Optional[str]:
+    """Refuse to gate a candidate without a *clean* scale-audit stamp.
+
+    bench.py stamps ``scale_audit`` (the jaxpr-level interval/dtype flow
+    proof that the kernels are wrap- and bounds-safe at the baseline
+    envelope) into every artifact.  Unlike the lint/mc gates, a missing
+    stamp also refuses: the audit ships with the stamp, so "missing"
+    can only mean the artifact was produced by a stripped bench or the
+    stamp was deleted — either way the number is unvouched."""
+    sa = new.get("scale_audit")
+    if isinstance(sa, dict) and sa.get("clean"):
+        return None
+    if sa is None:
+        return (
+            "candidate carries no scale_audit stamp; re-bench with the "
+            "current bench.py (python -m tpu_swirld.analysis scale-audit "
+            "proves the kernels wrap- and bounds-safe) before gating"
+        )
+    return (
+        f"candidate tree failed the scale audit ({sa!r}); run "
+        "python -m tpu_swirld.analysis scale-audit, fix or justify each "
+        "finding, and re-bench before gating"
+    )
+
+
 def compare(old: Dict, new: Dict, key: str, threshold: float):
     """Returns (failures, report_lines)."""
     lines = []
@@ -168,7 +193,7 @@ def main(argv=None) -> int:
         old = unwrap(json.load(f))
     with open(args.new) as f:
         new = unwrap(json.load(f))
-    for gate in (lint_gate(new), mc_gate(new)):
+    for gate in (lint_gate(new), mc_gate(new), scale_audit_gate(new)):
         if gate is not None:
             print(f"\nFAIL: {gate}", file=sys.stderr)
             return 1

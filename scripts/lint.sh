@@ -1,6 +1,10 @@
 #!/usr/bin/env bash
-# Invariant linter entry point: exits non-zero on any finding.
+# Static-analysis entry point: exits non-zero on any finding.
+#   1. invariant linter over the package (AST rules SW001..)
+#   2. scale audit at the baseline envelope (jaxpr interval/dtype flow,
+#      rules SW008-SW011) across all engines
 # Usage: scripts/lint.sh [paths...]   (default: the tpu_swirld package)
 set -euo pipefail
 cd "$(dirname "$0")/.."
-exec env JAX_PLATFORMS=cpu python -m tpu_swirld.analysis lint "${@:-tpu_swirld}"
+env JAX_PLATFORMS=cpu python -m tpu_swirld.analysis lint "${@:-tpu_swirld}"
+exec env JAX_PLATFORMS=cpu python -m tpu_swirld.analysis scale-audit --envelope baseline

@@ -427,8 +427,11 @@ def test_chaos_run_sanitize_smoke(tmp_path):
 
 def test_bench_compare_refuses_dirty_lint(tmp_path):
     """bench_compare.py: a candidate stamped with lint findings is not
-    gated; a clean stamp and a legacy stamp-less artifact are."""
+    gated; a clean stamp is (legacy lint-stamp-less artifacts pass the
+    lint gate, though the scale_audit gate is strict — see
+    test_bench_compare_refuses_missing_scale_audit)."""
     mod = _load_script("bench_compare")
+    sa = {"envelope": "baseline", "clean": True, "findings": 0}
     old = tmp_path / "old.json"
     old.write_text(json.dumps({"value": 100.0}))
 
@@ -436,6 +439,7 @@ def test_bench_compare_refuses_dirty_lint(tmp_path):
     dirty.write_text(json.dumps({
         "value": 120.0,
         "lint": {"findings": 2, "clean": False, "by_rule": {"SW002": 2}},
+        "scale_audit": sa,
     }))
     assert mod.main([str(old), str(dirty)]) == 1
 
@@ -443,10 +447,13 @@ def test_bench_compare_refuses_dirty_lint(tmp_path):
     clean.write_text(json.dumps({
         "value": 101.0,
         "lint": {"findings": 0, "clean": True, "by_rule": {}},
+        "scale_audit": sa,
     }))
     assert mod.main([str(old), str(clean)]) == 0
-    # pre-stamp artifacts (BENCH_r01..r05) still gate on metrics alone
-    assert mod.main([str(old), str(old)]) == 0
+    # a lint-stamp-less candidate still passes the *lint* gate
+    nostamp = tmp_path / "nostamp.json"
+    nostamp.write_text(json.dumps({"value": 100.0, "scale_audit": sa}))
+    assert mod.main([str(old), str(nostamp)]) == 0
 
 
 def test_bench_lint_stamp_shape():
@@ -463,8 +470,10 @@ def test_bench_lint_stamp_shape():
 
 def test_bench_compare_refuses_dirty_mc(tmp_path):
     """bench_compare.py: a candidate whose model-checker smoke stamp is
-    dirty is not gated; a clean stamp and a stamp-less artifact are."""
+    dirty is not gated; a clean stamp and an mc-stamp-less artifact
+    are."""
     mod = _load_script("bench_compare")
+    sa = {"envelope": "baseline", "clean": True, "findings": 0}
     old = tmp_path / "old.json"
     old.write_text(json.dumps({"value": 100.0}))
 
@@ -472,6 +481,7 @@ def test_bench_compare_refuses_dirty_mc(tmp_path):
     dirty.write_text(json.dumps({
         "value": 120.0,
         "mc": {"ok": False, "violations": 1, "exhaustive": True},
+        "scale_audit": sa,
     }))
     assert mod.main([str(old), str(dirty)]) == 1
 
@@ -479,10 +489,13 @@ def test_bench_compare_refuses_dirty_mc(tmp_path):
     clean.write_text(json.dumps({
         "value": 101.0,
         "mc": {"ok": True, "violations": 0, "exhaustive": True},
+        "scale_audit": sa,
     }))
     assert mod.main([str(old), str(clean)]) == 0
-    # pre-mc artifacts gate on metrics alone
-    assert mod.main([str(old), str(old)]) == 0
+    # pre-mc artifacts pass the mc gate on metrics alone
+    nostamp = tmp_path / "nostamp.json"
+    nostamp.write_text(json.dumps({"value": 100.0, "scale_audit": sa}))
+    assert mod.main([str(old), str(nostamp)]) == 0
 
 
 def test_bench_mc_stamp_shape():

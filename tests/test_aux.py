@@ -93,12 +93,13 @@ def test_bench_compare_tool(tmp_path):
     import subprocess
     import sys
 
+    sa = {"envelope": "baseline", "clean": True, "findings": 0}
     old = {"value": 1000.0, "phases": {"pipeline": 1.0},
            "incremental": {"steady_evps": 2000.0}}
     good = {"value": 950.0, "phases": {"pipeline": 1.1},
-            "incremental": {"steady_evps": 2100.0}}
+            "incremental": {"steady_evps": 2100.0}, "scale_audit": sa}
     bad = {"value": 800.0, "phases": {},
-           "incremental": {"steady_evps": 2100.0}}
+           "incremental": {"steady_evps": 2100.0}, "scale_audit": sa}
     po, pg, pb = tmp_path / "o.json", tmp_path / "g.json", tmp_path / "b.json"
     po.write_text(json.dumps(old))
     pg.write_text(json.dumps(good))
@@ -116,7 +117,7 @@ def test_bench_compare_tool(tmp_path):
     assert "REGRESSION" in r.stdout
     # regression in the incremental metric alone must also fail
     bad_inc = {"value": 1000.0, "phases": {},
-               "incremental": {"steady_evps": 1500.0}}
+               "incremental": {"steady_evps": 1500.0}, "scale_audit": sa}
     pbi = tmp_path / "bi.json"
     pbi.write_text(json.dumps(bad_inc))
     r = subprocess.run(

@@ -48,4 +48,20 @@ from tpu_swirld.analysis.lint import (  # noqa: F401
     lint_summary,
 )
 
-__all__ = ["Finding", "check_source", "lint_paths", "lint_summary"]
+__all__ = [
+    "Finding",
+    "check_source",
+    "lint_paths",
+    "lint_summary",
+    "scale_audit",
+    "scale_audit_stamp",
+]
+
+
+def __getattr__(name):
+    # lazy: the flow package pulls in jax; plain lint use must not
+    if name in ("scale_audit", "scale_audit_stamp"):
+        from tpu_swirld.analysis.flow import audit
+
+        return getattr(audit, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -2,13 +2,14 @@
 
 Subcommands::
 
-    lint      [paths...] [--json] [--rules ...] [--list-rules]
-    jit-audit [--static-only] [--members N] [--events N] [--engine E] [--json]
-    races     [--schedules N] [--seed S] [--rows N] [--json]
-    mc        [--n N] [--events N] [--forkers N] [--mutate NAME] [--json]
+    lint        [paths...] [--json] [--rules ...] [--list-rules]
+    jit-audit   [--static-only] [--members N] [--events N] [--engine E] [--json]
+    races       [--schedules N] [--seed S] [--rows N] [--json]
+    mc          [--n N] [--events N] [--forkers N] [--mutate NAME] [--json]
+    scale-audit [--envelope E] [--engine E] [--set F=V] [--mutate NAME] [--json]
 
 Each exits non-zero on findings / audit failures / schedule divergence /
-invariant violations, so all four slot directly into CI.
+invariant violations, so all five slot directly into CI.
 """
 
 from __future__ import annotations
@@ -30,8 +31,11 @@ def main(argv=None) -> int:
         from tpu_swirld.analysis.races import main as m
     elif cmd == "mc":
         from tpu_swirld.analysis.mc.cli import main as m
+    elif cmd == "scale-audit":
+        from tpu_swirld.analysis.flow.audit import main as m
     else:
-        print(f"unknown subcommand {cmd!r} (lint | jit-audit | races | mc)")
+        print(f"unknown subcommand {cmd!r} "
+              f"(lint | jit-audit | races | mc | scale-audit)")
         return 2
     return m(rest)
 

@@ -1,0 +1,440 @@
+"""Scale-audit driver: prove every consensus stage safe at an envelope.
+
+``scale_audit`` traces each catalog stage (:mod:`.stages`) to its jaxpr
+at the envelope's shapes, runs the abstract interpreter over it, applies
+the ``# swirld-lint: disable=SW00x -- <why>`` suppressions from the
+flagged source lines (the justification text after ``--`` is
+*required*; a bare disable is itself a failure), folds in the host-side
+closed-form checks (:func:`~.envelope.host_envelope_findings`), and
+verifies stage coverage: every ``obs.stage_call`` name a real small run
+of each engine emits must map to at least one audited spec.
+
+Teeth are proven, not assumed: ``--mutate`` re-runs the audit against a
+seeded defect (an int16-narrowed tally accumulator, a dropped index
+clip) mirroring the real stage code; the auditor must pinpoint it.  The
+tier-1 tests assert the exact rule, file, and primitive for each
+mutation, so a silently weakened transfer function fails CI.
+
+Exit codes (``python -m tpu_swirld.analysis scale-audit``):
+
+* ``0`` — proven clean at the envelope (all findings suppressed with
+  justification, no coverage gaps),
+* ``1`` — findings, unjustified suppressions, or coverage gaps,
+* ``2`` — the transfer registry met a primitive it does not model (it
+  refuses to guess).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tpu_swirld.analysis.lint import Finding, suppression_notes
+from tpu_swirld.analysis.flow import stages
+from tpu_swirld.analysis.flow.envelope import (
+    ScaleEnvelope,
+    get_envelope,
+    host_envelope_findings,
+    preset_names,
+)
+from tpu_swirld.analysis.flow.interpret import RULE_NAMES, interpret_jaxpr
+from tpu_swirld.analysis.flow.transfer import UnknownPrimitiveError
+
+
+# --------------------------------------------------------------------------
+# seeded mutations (the auditor's self-test)
+
+
+def _mut_ssm_acc_int16(env: ScaleEnvelope):
+    """pipeline.ssm_block_stage's member tally with the accumulator
+    seeded to int16: the per-member vote sum reaches events*stake_max,
+    so the narrowing cast must be flagged (SW010) and the int16
+    accumulation wraps (SW008)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    d = stages._dims(env)
+    n, b, m = d["N"], d["block"], d["M"]
+
+    @jax.jit
+    def mut_ssm_block_tally(sees, creator, stake):
+        def body(mm, acc):
+            contrib = sees & (creator[None, :] == mm)
+            votes = jnp.sum(contrib * stake[mm], axis=1)
+            return acc + votes.astype(jnp.int16)  # seeded defect
+        acc0 = jnp.zeros((b,), jnp.int16)
+        return lax.fori_loop(0, m, body, acc0)
+
+    decls = [
+        stages._mask((b, n)),
+        stages._arr((n,), lo=0, hi=m - 1),
+        stages._arr((m,), lo=0, hi=env.stake_max),
+    ]
+    return mut_ssm_block_tally, {}, decls
+
+
+def _mut_dropped_clip(env: ScaleEnvelope):
+    """pipeline's rounds-step witness-table lookup with the window-row
+    clip dropped: the parent round reaches events-1, far past the
+    r_cap-row table — the unclipped gather must be flagged (SW009)."""
+    import jax
+    import jax.numpy as jnp
+
+    d = stages._dims(env)
+    n, r, s = d["N"], d["R"], d["S"]
+
+    @jax.jit
+    def mut_rounds_widx(rnd, tab, p1):
+        r0 = rnd[jnp.maximum(p1, 0)]
+        widx = tab[r0]  # seeded defect: no clip to [0, r_cap-1]
+        return widx
+
+    decls = [
+        stages._arr((n,), lo=0, hi=n - 1),
+        stages._arr((r, s), lo=-1, hi=n - 1),
+        stages._scalar(-1, n - 1),
+    ]
+    return mut_rounds_widx, {}, decls
+
+
+#: mutation name -> (description, build)
+MUTATIONS = {
+    "ssm-acc-int16": (
+        "narrow the ssm block tally accumulator to int16",
+        _mut_ssm_acc_int16,
+    ),
+    "dropped-clip": (
+        "drop the round-window clip before the witness-table gather",
+        _mut_dropped_clip,
+    ),
+}
+
+
+# --------------------------------------------------------------------------
+# report
+
+
+@dataclasses.dataclass
+class AuditReport:
+    """Everything one ``scale_audit`` run established."""
+
+    envelope: str
+    engines: Tuple[str, ...]
+    findings: List[Finding]                    # unsuppressed
+    suppressed: List[Tuple[Finding, str]]      # (finding, justification)
+    unjustified: List[Finding]                 # bare disables — still fail
+    errors: List[str]                          # unknown-primitive reports
+    coverage_gaps: Dict[str, List[str]]        # engine -> unaudited stages
+    specs: List[str]
+    exercised: Set[str]
+    mutation: Optional[str] = None
+
+    @property
+    def clean(self) -> bool:
+        return not (
+            self.findings
+            or self.unjustified
+            or self.errors
+            or any(self.coverage_gaps.values())
+        )
+
+    @property
+    def exit_code(self) -> int:
+        if self.errors:
+            return 2
+        return 0 if self.clean else 1
+
+    def to_dict(self) -> Dict:
+        return {
+            "envelope": self.envelope,
+            "engines": list(self.engines),
+            "mutation": self.mutation,
+            "clean": self.clean,
+            "exit_code": self.exit_code,
+            "findings": [f.to_dict() for f in self.findings],
+            "suppressed": [
+                {**f.to_dict(), "justification": note}
+                for f, note in self.suppressed
+            ],
+            "unjustified": [f.to_dict() for f in self.unjustified],
+            "errors": list(self.errors),
+            "coverage_gaps": {k: v for k, v in self.coverage_gaps.items() if v},
+            "specs": list(self.specs),
+            "exercised": sorted(self.exercised),
+        }
+
+    def render(self) -> str:
+        lines: List[str] = []
+        for f in self.findings:
+            lines.append(f.render())
+        for f in self.unjustified:
+            lines.append(f.render())
+        for eng, gaps in sorted(self.coverage_gaps.items()):
+            for g in gaps:
+                lines.append(
+                    f"coverage[{eng}]: stage {g!r} observed at runtime but "
+                    f"not covered by any audited spec")
+        for e in self.errors:
+            lines.append(f"error: {e}")
+        n_sites = len({(f.path, f.line, f.rule) for f in self.findings})
+        lines.append(
+            f"scale-audit[{self.envelope}"
+            + (f", mutate={self.mutation}" if self.mutation else "")
+            + f"]: {len(self.specs)} stage specs over "
+            f"{'/'.join(self.engines)} — "
+            + (
+                "proven clean"
+                if self.clean
+                else f"{len(self.findings)} finding(s) at {n_sites} site(s), "
+                     f"{len(self.unjustified)} unjustified suppression(s), "
+                     f"{sum(len(v) for v in self.coverage_gaps.values())} "
+                     f"coverage gap(s), {len(self.errors)} error(s)"
+            )
+            + f"; {len(self.suppressed)} justified suppression(s)"
+        )
+        return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------
+# suppression application
+
+
+def _apply_suppressions(
+    findings: Sequence[Finding],
+) -> Tuple[List[Finding], List[Tuple[Finding, str]], List[Finding]]:
+    """Split findings into (kept, suppressed-with-note, unjustified)."""
+    cache: Dict[str, Dict[int, Tuple[set, str]]] = {}
+    kept: List[Finding] = []
+    suppressed: List[Tuple[Finding, str]] = []
+    unjustified: List[Finding] = []
+    for f in findings:
+        notes = cache.get(f.path)
+        if notes is None:
+            try:
+                with open(f.path, "r", encoding="utf-8") as fh:
+                    notes = suppression_notes(fh.read())
+            except OSError:
+                notes = {}
+            cache[f.path] = notes
+        ids, note = notes.get(f.line, (set(), ""))
+        if ids and (f.rule in ids or f.name in ids or "all" in ids):
+            if note:
+                suppressed.append((f, note))
+            else:
+                unjustified.append(dataclasses.replace(
+                    f,
+                    message=f.message + " [suppressed without justification "
+                    "— the audit requires `-- <why it is safe>` after the "
+                    "id list]",
+                ))
+        else:
+            kept.append(f)
+    return kept, suppressed, unjustified
+
+
+# --------------------------------------------------------------------------
+# driver
+
+
+def _run_specs(env, specs, errors, exercised):
+    findings: List[Finding] = []
+    for spec in specs:
+        try:
+            closed, ivs = stages.trace_spec(spec, env)
+        except Exception as exc:  # trace failure is an audit failure
+            errors.append(f"{spec.spec_id}: trace failed: {exc!r}")
+            continue
+        axis = (
+            stages.mesh_axis_sizes(env)
+            if spec.spec_id.startswith("mesh.")
+            else None
+        )
+        try:
+            interpret_jaxpr(
+                closed, ivs,
+                stage=spec.spec_id,
+                sentinels=env.sentinels,
+                axis_sizes=axis,
+                findings=findings,
+                exercised=exercised,
+            )
+        except UnknownPrimitiveError as exc:
+            errors.append(
+                f"{spec.spec_id}: unknown primitive {exc.primitive!r} at "
+                f"{exc.where} — no transfer function registered")
+    return findings
+
+
+def scale_audit(
+    envelope: str = "baseline",
+    engines: Optional[Sequence[str]] = None,
+    *,
+    overrides: Optional[Dict[str, int]] = None,
+    check_coverage: bool = True,
+    mutate: Optional[str] = None,
+) -> AuditReport:
+    """Run the full scale audit; see the module docstring.
+
+    ``mutate`` replaces the catalog with the named seeded defect (the
+    self-test: the report is *expected* dirty; exit code 1 proves the
+    auditor catches it).
+    """
+    engines = tuple(engines) if engines else stages.ENGINES
+    bad = set(engines) - set(stages.ENGINES)
+    if bad:
+        raise ValueError(f"unknown engines: {sorted(bad)}")
+    env = get_envelope(envelope, overrides)
+
+    errors: List[str] = []
+    exercised: Set[str] = set()
+
+    if mutate is not None:
+        if mutate not in MUTATIONS:
+            raise ValueError(
+                f"unknown mutation {mutate!r} (have {sorted(MUTATIONS)})")
+        desc, build = MUTATIONS[mutate]
+        spec = stages.StageSpec(
+            spec_id=f"mutation.{mutate}",
+            stage_name=f"mutation.{mutate}",
+            engines=engines,
+            build=build,
+        )
+        raw = _run_specs(env, [spec], errors, exercised)
+        # mutations are never suppressible: they live in this file, which
+        # carries no swirld-lint comments
+        kept, suppressed, unjustified = _apply_suppressions(raw)
+        return AuditReport(
+            envelope=env.name, engines=engines, findings=kept,
+            suppressed=suppressed, unjustified=unjustified, errors=errors,
+            coverage_gaps={}, specs=[spec.spec_id], exercised=exercised,
+            mutation=mutate,
+        )
+
+    specs = stages.specs_for_engines(engines)
+    raw = _run_specs(env, specs, errors, exercised)
+    raw.extend(host_envelope_findings(env))
+    kept, suppressed, unjustified = _apply_suppressions(raw)
+
+    coverage_gaps: Dict[str, List[str]] = {}
+    if check_coverage:
+        cmap = stages.coverage_map()
+        for eng in engines:
+            try:
+                observed = stages.observed_stage_names(eng)
+            except Exception as exc:
+                errors.append(f"coverage[{eng}]: runtime probe failed: "
+                              f"{exc!r}")
+                continue
+            coverage_gaps[eng] = [s for s in observed if s not in cmap]
+
+    return AuditReport(
+        envelope=env.name, engines=engines, findings=kept,
+        suppressed=suppressed, unjustified=unjustified, errors=errors,
+        coverage_gaps=coverage_gaps,
+        specs=[s.spec_id for s in specs], exercised=exercised,
+    )
+
+
+@functools.lru_cache(maxsize=4)
+def _cached_stamp(envelope: str, engines: Tuple[str, ...]) -> Tuple:
+    rep = scale_audit(envelope, engines, check_coverage=False)
+    return (
+        rep.clean,
+        len(rep.findings) + len(rep.unjustified),
+        len(rep.suppressed),
+        len(rep.errors),
+    )
+
+
+def scale_audit_stamp(
+    envelope: str = "baseline",
+    engines: Optional[Sequence[str]] = None,
+) -> Dict:
+    """The shape bench.py stamps into JSON artifacts: whether the tree
+    the benchmark ran from is proven scale-safe.  ``bench_compare.py``
+    refuses to gate on an artifact whose stamp is dirty or missing.
+
+    Coverage probing is skipped here (it runs real consensus workloads;
+    the analyzer's own CI covers it) — the stamp is about *this tree's
+    kernels*, cached per process since bench stamps several artifacts.
+    """
+    engines = tuple(engines) if engines else stages.ENGINES
+    clean, n_findings, n_suppressed, n_errors = _cached_stamp(
+        envelope, engines)
+    return {
+        "envelope": envelope,
+        "engines": list(engines),
+        "clean": clean,
+        "findings": n_findings,
+        "suppressed": n_suppressed,
+        "errors": n_errors,
+    }
+
+
+# --------------------------------------------------------------------------
+# CLI
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu_swirld.analysis scale-audit",
+        description=__doc__.splitlines()[0],
+    )
+    ap.add_argument(
+        "--envelope", default="baseline", choices=preset_names(),
+        help="declared operating point to prove (default baseline)")
+    ap.add_argument(
+        "--engine", action="append", choices=list(stages.ENGINES),
+        help="engine(s) to audit; repeatable (default all)")
+    ap.add_argument(
+        "--set", action="append", default=[], metavar="FIELD=VALUE",
+        dest="overrides", help="override an envelope field (with "
+        "--envelope custom); repeatable")
+    ap.add_argument(
+        "--mutate", choices=sorted(MUTATIONS),
+        help="audit a seeded defect instead of the real stages (self-"
+        "test: exit 1 proves the defect is caught)")
+    ap.add_argument(
+        "--no-coverage", action="store_true",
+        help="skip the runtime stage-coverage probe")
+    ap.add_argument("--json", action="store_true", help="machine output")
+    ap.add_argument(
+        "--list-rules", action="store_true",
+        help="print the flow rule catalog")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, name in sorted(RULE_NAMES.items()):
+            print(f"{rid} {name}")
+        return 0
+
+    overrides: Dict[str, int] = {}
+    for kv in args.overrides:
+        k, sep, v = kv.partition("=")
+        if not sep:
+            ap.error(f"--set expects FIELD=VALUE, got {kv!r}")
+        overrides[k.strip()] = int(v)
+
+    rep = scale_audit(
+        args.envelope,
+        args.engine,
+        overrides=overrides or None,
+        check_coverage=not args.no_coverage and args.mutate is None,
+        mutate=args.mutate,
+    )
+    if args.json:
+        print(json.dumps(rep.to_dict(), indent=2))
+    else:
+        print(rep.render())
+    return rep.exit_code
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

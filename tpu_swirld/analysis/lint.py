@@ -153,32 +153,61 @@ def _donated_positions(fn: ast.FunctionDef) -> Tuple[int, ...]:
 
 def _suppressions(source: str) -> Tuple[Dict[int, set], set]:
     """``(per_line, per_file)`` suppression sets parsed from
-    ``# swirld-lint:`` comments (rule ids, rule names, or ``all``)."""
+    ``# swirld-lint:`` comments (rule ids, rule names, or ``all``).
+
+    The id list is the first whitespace-delimited token after
+    ``disable=``; anything after it is a free-form justification
+    (``# swirld-lint: disable=SW008 -- tally < 2**24 by config cap``).
+    The scale auditor *requires* that justification text
+    (:func:`suppression_notes`); plain lint ignores it."""
     per_line: Dict[int, set] = {}
     per_file: set = set()
-    try:
-        toks = tokenize.generate_tokens(io.StringIO(source).readline)
-        for tok in toks:
-            if tok.type != tokenize.COMMENT:
-                continue
-            text = tok.string.lstrip("#").strip()
-            if not text.startswith("swirld-lint:"):
-                continue
-            body = text[len("swirld-lint:"):].strip()
-            if body.startswith("disable-file="):
-                if tok.start[0] <= 10:
-                    per_file.update(
-                        x.strip()
-                        for x in body[len("disable-file="):].split(",")
-                    )
-            elif body.startswith("disable="):
-                ids = {
-                    x.strip() for x in body[len("disable="):].split(",")
-                }
-                per_line.setdefault(tok.start[0], set()).update(ids)
-    except tokenize.TokenError:
-        pass
+    for lineno, kind, ids, _note in _suppression_comments(source):
+        if kind == "file":
+            if lineno <= 10:
+                per_file.update(ids)
+        else:
+            per_line.setdefault(lineno, set()).update(ids)
     return per_line, per_file
+
+
+def _suppression_comments(source: str):
+    """Yields ``(lineno, kind, ids, note)`` for every ``# swirld-lint:``
+    comment; ``kind`` is ``"line"`` or ``"file"``, ``note`` the
+    justification text following the id list (leading ``--`` stripped)."""
+    try:
+        toks = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except tokenize.TokenError:
+        return
+    for tok in toks:
+        if tok.type != tokenize.COMMENT:
+            continue
+        text = tok.string.lstrip("#").strip()
+        if not text.startswith("swirld-lint:"):
+            continue
+        body = text[len("swirld-lint:"):].strip()
+        for prefix, kind in (("disable-file=", "file"), ("disable=", "line")):
+            if body.startswith(prefix):
+                spec = body[len(prefix):]
+                ids_part, _, note = spec.partition(" ")
+                ids = {x.strip() for x in ids_part.split(",") if x.strip()}
+                note = note.strip()
+                if note.startswith("--"):
+                    note = note[2:].strip()
+                yield tok.start[0], kind, ids, note
+                break
+
+
+def suppression_notes(source: str) -> Dict[int, Tuple[set, str]]:
+    """Per-line suppressions *with* their justification text, for
+    auditors that refuse an unjustified suppression."""
+    out: Dict[int, Tuple[set, str]] = {}
+    for lineno, kind, ids, note in _suppression_comments(source):
+        if kind != "line":
+            continue
+        prev_ids, prev_note = out.get(lineno, (set(), ""))
+        out[lineno] = (prev_ids | ids, note or prev_note)
+    return out
 
 
 def _suppressed(f: Finding, per_line: Dict[int, set], per_file: set) -> bool:

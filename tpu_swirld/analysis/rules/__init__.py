@@ -31,6 +31,22 @@ Catalog:
   ``transport.py``, ``parallel.py``, ``packing.py``): asserts vanish
   under ``python -O``; safety checks must be explicit raises (with a
   counter where useful).
+
+The SW008–SW011 ids belong to the scale-envelope flow audit
+(:mod:`tpu_swirld.analysis.flow`) — they are emitted by the jaxpr-level
+abstract interpreter rather than an AST pass, but share this id space,
+finding format, and suppression syntax (with a *required* ``--
+<justification>`` tail):
+
+- **SW008 overflow-reachable** — an integer result's proven value
+  interval escapes its dtype at the declared scale envelope.
+- **SW009 unproven-bounds** — a gather/scatter/``dynamic_slice`` index
+  interval is not provably inside the operand extent (XLA would clamp
+  or drop silently).
+- **SW010 lossy-narrowing** — a ``convert_element_type`` narrows to a
+  dtype that cannot represent the operand's proven interval.
+- **SW011 sentinel-collision** — a live value range can collide with a
+  padding sentinel (e.g. ``INT32_MAX`` timestamps in the order stage).
 """
 
 from __future__ import annotations

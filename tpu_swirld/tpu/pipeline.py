@@ -573,7 +573,7 @@ def order_scan(
 
             ts0 = jnp.full((s_max, n), INT32_MAX, dtype=jnp.int32)
             (cur, tsw), _ = lax.scan(walk, (we, ts0), None, length=chain)
-            tsw = jnp.where(ufw[:, None], tsw, INT32_MAX)  # mask non-UFW rows
+            tsw = jnp.where(ufw[:, None], tsw, INT32_MAX)  # swirld-lint: disable=SW011 -- masking non-UFW rows TO the sort sentinel is the point: they sort last, and med_i < nv keeps the median strictly below any masked row (the packer bounds live timestamps under INT32_MAX)
             ts_sorted = jnp.sort(tsw, axis=0)            # S,N ascending
             nv = jnp.sum(ufw)
             med_i = jnp.clip((nv - 1) // 2, 0, s_max - 1)
