@@ -202,6 +202,7 @@ def run_default():
 
     from tpu_swirld import obs as obslib
     from tpu_swirld.metrics import Metrics
+    from tpu_swirld.obs.finality import FinalityTracker, record_batch_result
     from tpu_swirld.oracle.node import Node
     from tpu_swirld.packing import pack_events
     from tpu_swirld.sim import generate_gossip_dag
@@ -240,6 +241,17 @@ def run_default():
     oracle_evps = n_oracle / t_oracle
     log(f"[oracle] {n_oracle} events in {t_oracle:.2f}s = {oracle_evps:.0f} ev/s "
         f"(ordered {len(node.consensus)}, max_round {node.max_round})")
+    # finality lifecycle, oracle engine: rounds-to-decision is exact per
+    # event; the single batch pass makes time-to-finality degenerate
+    # (every event shares the pass wall-clock), recorded post-hoc so the
+    # tracker never perturbs the timed region
+    fin_oracle = FinalityTracker("oracle", registry=o.registry)
+    for eid in node.consensus:
+        fin_oracle.record_decided(
+            eid, node.round[eid], node.round_received[eid],
+            birth=0.0, now=t_oracle,
+        )
+    finality = {"oracle": fin_oracle.summary()}
 
     # ---- device pipeline (full DAG), parity-checked on the oracle prefix --
     t0 = time.time()
@@ -273,6 +285,9 @@ def run_default():
     pipe_evps = n_events / t_steady
     log(f"[pipeline] first {t_compile_and_run:.2f}s, steady {t_steady:.2f}s = "
         f"{pipe_evps:.0f} ev/s (ordered {len(res.order)}, max_round {res.max_round})")
+    fin_batch = FinalityTracker("batch", registry=o.registry)
+    record_batch_result(fin_batch, res, now=t_steady, birth=0.0)
+    finality["batch"] = fin_batch.summary()
 
     # ---- incremental steady-state mode: chunked ingest, carried state ----
     inc_out = None
@@ -280,6 +295,12 @@ def run_default():
         from tpu_swirld.tpu.pipeline import IncrementalConsensus
 
         inc = IncrementalConsensus(members, stake, node.config)
+        # genuine steady-state time-to-finality: births stamp at chunk
+        # ingest, decided at the pass that orders them — both on the
+        # tracker's wall clock
+        inc.finality = FinalityTracker(
+            "incremental", clock=time.perf_counter, registry=o.registry
+        )
         pass_stats = []
         with o.tracer.span("pipeline_incremental"), \
                 mon.phase("pipeline_incremental"):
@@ -329,6 +350,7 @@ def run_default():
             "pruned_prefix": inc.pruned_prefix,
             "parity": bool(inc_parity),
         }
+        finality["incremental"] = inc.finality.summary()
 
     phases = {k: round(v, 4) for k, v in o.tracer.phase_seconds().items()}
     if inc_out is not None:
@@ -357,6 +379,14 @@ def run_default():
     }
     if inc_out is not None:
         out["incremental"] = inc_out
+    out["finality"] = {
+        eng: {
+            k: (round(v, 6) if isinstance(v, float) else v)
+            for k, v in s.items()
+        }
+        for eng, s in finality.items()
+    }
+    log(f"[finality] {json.dumps(out['finality'])}")
     out["lint"] = lint_stamp()
     out["mc"] = mc_stamp()
     out["scale_audit"] = scale_audit_stamp()
@@ -446,6 +476,13 @@ def run_stream(tile_budget, tile, mesh_n=0, device_tile_budget=None):
             tile_budget=tile_budget, tile=tile,
             ingest_chunk=STREAM_CHUNK, window_bucket=2048, prune_min=1024,
         )
+    # finality lifecycle on the stream: births at chunk ingest, decided
+    # at the ordering pass; the phase dimension attributes each decided
+    # event's latency to window residency vs archive widening vs full
+    # rebase (see StreamingConsensus._rebase)
+    from tpu_swirld.obs.finality import FinalityTracker
+
+    inc.finality = FinalityTracker("streaming", clock=time.perf_counter)
     n_done = 0
     t_all = time.time()
     with mon.phase("stream"):
@@ -597,7 +634,14 @@ def run_stream(tile_budget, tile, mesh_n=0, device_tile_budget=None):
             "compile_cache": bool(cache_dir),
             "parity": bool(parity),
         },
+        "finality": {
+            "streaming": {
+                k: (round(v, 6) if isinstance(v, float) else v)
+                for k, v in inc.finality.summary().items()
+            },
+        },
     }
+    log(f"[finality] {json.dumps(out['finality'])}")
     if mesh_out is not None:
         out["stream_mesh"] = mesh_out
         out["metric"] = out["metric"].replace(

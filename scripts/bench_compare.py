@@ -17,7 +17,12 @@ files carry them:
 - the peak-memory metrics ``peak_host_bytes`` / ``peak_device_bytes`` /
   ``stream.peak_resident_visibility_bytes`` (LOWER is better — a rise
   >threshold fails, so a change that silently re-materializes an
-  O(N²) slab trips the gate even when throughput improves).
+  O(N²) slab trips the gate even when throughput improves);
+- the finality-latency metrics ``finality.<engine>.ttf_p99`` (p99
+  time-to-finality, seconds) and ``finality.<engine>.rtd_mean`` (mean
+  rounds-to-decision) for the incremental/batch/streaming engines
+  (LOWER is better — deciding the same history later is a latency
+  regression even when events/sec holds).
 
 Driver artifacts that wrap the bench line (``{"cmd": ..., "parsed":
 {...}}`` — the BENCH_rNN.json files) are unwrapped automatically, so
@@ -66,6 +71,16 @@ EXTRA_KEYS = [
     ("chaos_overhead.clean_evps", True),
     ("chaos_overhead.attack_evps", True),
     ("chaos_overhead.ratio", True),
+    # finality-latency artifacts (the bench `finality` section): p99
+    # time-to-finality and mean rounds-to-decision are LOWER-is-better —
+    # a change that decides the same history later (more virtual-voting
+    # rounds, slower window passes) regresses user-visible latency even
+    # when throughput holds
+    ("finality.incremental.ttf_p99", False),
+    ("finality.incremental.rtd_mean", False),
+    ("finality.batch.rtd_mean", False),
+    ("finality.streaming.ttf_p99", False),
+    ("finality.streaming.rtd_mean", False),
 ]
 
 

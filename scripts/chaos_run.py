@@ -66,12 +66,25 @@ def build_scenario(args) -> ChaosScenario:
     )
 
 
+def _flightrec(args):
+    """--flightrec-dir: one black-box recorder per scenario run.  Red
+    verdicts (and in-run anomalies: breaker opens, overflow heals, rebase
+    storms) auto-dump self-contained post-mortem JSONs into the
+    directory; ``None`` when the flag is off keeps recording disabled."""
+    if not getattr(args, "flightrec_dir", None):
+        return None
+    from tpu_swirld.obs.flightrec import FlightRecorder
+
+    return FlightRecorder(dump_dir=args.flightrec_dir)
+
+
 def _run_acceptance(args, ckpt_dir, o) -> dict:
     """The composed fault scenario: lossy/reordering transport, one
     scheduled partition + heal, one crash + checkpoint-restart, optional
     equivocating forkers; cross-engine parity over the surviving DAG."""
     sim = ChaosSimulation(
         build_scenario(args), ckpt_dir, metrics=Metrics(o.registry),
+        flightrec=_flightrec(args),
     )
     verdict = sim.run()
     # cross-engine parity over the chaos-shaped DAG: the most complete
@@ -87,18 +100,21 @@ def _run_acceptance(args, ckpt_dir, o) -> dict:
         and engines["batch_oracle_parity"]
         and engines["incremental_batch_parity"]
     )
+    if not verdict["ok"] and not verdict.get("flightrec_dump"):
+        verdict["flightrec_dump"] = sim.flightrec_postmortem(verdict)
     return verdict
 
 
 def _adapt(fn):
     """Registry runner -> CLI runner: scenarios registered in
     :data:`tpu_swirld.adversary.SCENARIOS` share the uniform signature
-    ``fn(ckpt_dir, seed=, engine=, metrics=)``; ``--seed`` left at its
-    default passes ``None`` so each scenario keeps its pinned seed."""
+    ``fn(ckpt_dir, seed=, engine=, metrics=, flightrec=)``; ``--seed``
+    left at its default passes ``None`` so each scenario keeps its
+    pinned seed."""
     def run(args, ckpt_dir, o) -> dict:
         return fn(
             ckpt_dir, seed=args.seed, engine=args.engine,
-            metrics=Metrics(o.registry),
+            metrics=Metrics(o.registry), flightrec=_flightrec(args),
         )
     return run
 
@@ -113,8 +129,12 @@ RUNNERS.update({name: _adapt(fn) for name, fn in SCENARIOS.items()})
 
 def run_scenario(args, ckpt_dir, o) -> dict:
     """One full scenario run under the ambient Obs ``o``; returns the
-    verdict dict (shared by the main run, --all, and --sanitize re-runs)."""
-    return RUNNERS[args.scenario](args, ckpt_dir, o)
+    verdict dict (shared by the main run, --all, and --sanitize re-runs).
+    Every verdict carries a ``flightrec_dump`` key: the post-mortem path
+    when a recorder was active and the verdict failed, else ``None``."""
+    verdict = RUNNERS[args.scenario](args, ckpt_dir, o)
+    verdict.setdefault("flightrec_dump", None)
+    return verdict
 
 
 def _verdict_fingerprint(verdict: dict) -> tuple:
@@ -288,6 +308,16 @@ def main(argv=None) -> int:
         "replaying counterexample, and a clean replayable schedule "
         "document gated on cross-engine parity under --engine",
     )
+    ap.add_argument(
+        "--flightrec-dir", default=None, metavar="DIR",
+        help="enable the black-box flight recorder: every node keeps a "
+        "bounded ring of recent activity and a failing verdict (or an "
+        "in-run anomaly: circuit-breaker open, overflow heal, rebase "
+        "storm) writes a self-contained post-mortem JSON into DIR; the "
+        "verdict's 'flightrec_dump' field records the dump path (null "
+        "when the run is green or the flag is off).  Ring sizing via "
+        "SWIRLD_FLIGHTREC_CAPACITY / SWIRLD_FLIGHTREC_MAX_DUMPS.",
+    )
     ap.add_argument("--out", default="chaos_verdict.json")
     args = ap.parse_args(argv)
 
@@ -353,7 +383,7 @@ def main(argv=None) -> int:
     with open(args.out, "w") as f:
         json.dump(verdict, f, indent=2, sort_keys=True)
     for key in ("safety", "liveness", "horizon", "fork_storm", "round_clamp",
-                "adversary", "engines", "sanitizer", "mc"):
+                "adversary", "engines", "sanitizer", "mc", "flightrec_dump"):
         if key in verdict:
             print(json.dumps({key: verdict[key]}, sort_keys=True))
     print(f"verdict: {'OK' if verdict['ok'] else 'FAIL'} -> {args.out}")

@@ -1,0 +1,320 @@
+"""Finality observatory: cross-engine latency parity, flight-recorder
+determinism, forced post-mortems, and exporter golden formats.
+
+``rounds_to_decision = round_received - round`` is a pure function of
+the DAG, so every engine — the Python oracle, the batch device pass,
+``IncrementalConsensus``, and ``StreamingConsensus`` — must report the
+bit-identical sequence for the same history even though their wall-clock
+``time_to_finality`` differs.  The flight recorder is a determinism
+surface too: the same scenario + seed must produce byte-identical
+post-mortem dumps (``wall_time_s`` stays ``None`` in sims).
+"""
+
+import json
+import os
+
+import pytest
+
+from tpu_swirld.config import SwirldConfig
+from tpu_swirld.obs.finality import FinalityTracker, record_batch_result
+from tpu_swirld.obs.flightrec import FlightRecorder, load_dump
+from tpu_swirld.obs.registry import Registry
+from tpu_swirld.oracle.node import Node
+from tpu_swirld.packing import pack_events
+from tpu_swirld.sim import generate_gossip_dag
+from tpu_swirld.store import StreamingConsensus
+from tpu_swirld.tpu.pipeline import IncrementalConsensus, run_consensus
+
+
+# --------------------------------------------------- cross-engine parity
+
+
+def _rtd_all_engines(n_members, n_events, seed, n_forkers):
+    """Drive the same generated DAG through all four engines and return
+    {engine: rtd list} (each in that engine's decided order)."""
+    members, stake, events, keys = generate_gossip_dag(
+        n_members, n_events, seed=seed, n_forkers=n_forkers
+    )
+    cfg = SwirldConfig(n_members=n_members)
+
+    # oracle observer: logical clock pinned at 0; birth stamps are the
+    # events' own t ticks, so the negative-TTF guard drops every TTF
+    # sample and only the pure-DAG rtd sequence records
+    node = Node(
+        sk=keys[0][1], pk=members[0], network={}, members=members,
+        clock=lambda: 0, create_genesis=False, config=cfg,
+    )
+    node.finality = FinalityTracker("oracle", clock=lambda: 0)
+    new_ids = [ev.id for ev in events if node.add_event(ev)]
+    node.consensus_pass(new_ids)
+
+    packed = pack_events(events, members, stake)
+    res = run_consensus(packed, cfg, block=64)
+    fin_batch = FinalityTracker("batch")
+    record_batch_result(fin_batch, res)
+
+    inc = IncrementalConsensus(
+        members, stake, cfg, block=64, chunk=64, window_bucket=512,
+        prune_min=128,
+    )
+    inc.finality = FinalityTracker("incremental")
+    for i in range(0, len(events), 100):
+        inc.ingest(events[i : i + 100])
+
+    st = StreamingConsensus(
+        members, stake, cfg, block=64, chunk=64, window_bucket=512,
+        prune_min=128, ingest_chunk=128,
+    )
+    st.finality = FinalityTracker("streaming")
+    for i in range(0, len(events), 100):
+        st.ingest(events[i : i + 100])
+
+    return {
+        "oracle": node.finality,
+        "batch": fin_batch,
+        "incremental": inc.finality,
+        "streaming": st.finality,
+    }
+
+
+@pytest.mark.parametrize(
+    "shape",
+    [
+        pytest.param((8, 400, 3, 2), id="8m-400ev-2forkers"),
+        pytest.param((6, 300, 5, 0), id="6m-300ev-honest"),
+    ],
+)
+def test_rounds_to_decision_bit_identical_across_engines(shape):
+    """The latency-parity contract: identical rtd sequences everywhere.
+
+    Uses the exact sample lists (not summaries), so a single transposed
+    or off-by-one decision anywhere fails loudly."""
+    trackers = _rtd_all_engines(*shape)
+    ref = trackers["oracle"].rtd
+    assert len(ref) > 0, "corpus must decide events or the test is vacuous"
+    for engine in ("batch", "incremental", "streaming"):
+        assert trackers[engine].rtd == ref, (
+            f"{engine} rtd diverges from oracle"
+        )
+    # the summary digests agree too (same samples -> same stats)
+    s_ref = trackers["oracle"].summary()
+    for engine in ("batch", "incremental", "streaming"):
+        s = trackers[engine].summary()
+        assert s["decided"] == s_ref["decided"]
+        for k in ("rtd_mean", "rtd_p50", "rtd_p99", "rtd_max"):
+            assert s[k] == s_ref[k], f"{engine} {k} != oracle"
+
+
+def test_summary_distribution_fields():
+    fin = FinalityTracker("batch")
+    for rtd in (1, 2, 2, 3):
+        fin.record_decided(rtd, 0, rtd)
+    s = fin.summary()
+    assert s["decided"] == 4
+    assert s["rtd_mean"] == 2.0
+    assert s["rtd_p50"] == 2
+    assert s["rtd_max"] == 3
+    assert s["undecided"] == 0
+
+
+def test_negative_ttf_guard_drops_cross_domain_samples():
+    """A logical-tick birth meeting a wall-clock 'now' must not poison
+    the TTF histogram with negative latencies."""
+    fin = FinalityTracker("oracle", clock=lambda: 0.0)
+    fin.record_decided(b"e", 1, 2, birth=57.0)   # decided "before" born
+    assert fin.rtd == [1]
+    assert fin.ttf == []
+    fin.record_decided(b"f", 1, 3, birth=0.0, now=2.5)
+    assert fin.ttf == [2.5]
+
+
+# ------------------------------------------- flight-recorder determinism
+
+
+def _failing_scenario():
+    """A run that cannot satisfy liveness: every link drops everything,
+    and a partition window adds breaker churn for extra ring traffic."""
+    from tpu_swirld.chaos import ChaosScenario
+    from tpu_swirld.transport import FaultPlan, LinkFaults, Partition
+
+    return ChaosScenario(
+        n_nodes=4, n_turns=10, seed=0, checkpoint_every=5,
+        plan=FaultPlan(
+            default=LinkFaults(drop=1.0),
+            partitions=[Partition(start=1, end=8, group=(0, 1))],
+        ),
+    )
+
+
+def _run_failing(tmp_dir):
+    from tpu_swirld.chaos import ChaosSimulation
+
+    rec = FlightRecorder(dump_dir=tmp_dir)
+    sim = ChaosSimulation(
+        _failing_scenario(), os.path.join(tmp_dir, "ckpt"), flightrec=rec,
+    )
+    verdict = sim.run()
+    return sim, rec, verdict
+
+
+def test_forced_failure_writes_loadable_dump_matching_frontier(tmp_path):
+    """The acceptance criterion: a verdict failure dumps a post-mortem
+    whose decided frontier matches the live nodes' state exactly."""
+    sim, rec, verdict = _run_failing(str(tmp_path))
+    assert not verdict["ok"]
+    path = verdict["flightrec_dump"]
+    assert path is not None and os.path.exists(path)
+    doc = load_dump(path)
+    assert doc["reason"] == "verdict_failed"
+    frontier = doc["decided_frontier"]
+    for i, node in sorted(sim.nodes.items()):
+        if node is None:
+            continue
+        row = frontier[f"n{i}"]
+        assert row["decided"] == len(node.consensus)
+        assert row["consensus_round"] == node.consensus_round
+        assert row["events"] == len(node.hg)
+    # every node's ring contributed records to the snapshot
+    assert set(doc["rings"]) >= {
+        f"n{i}" for i, n in sim.nodes.items() if n is not None
+    }
+
+
+def test_flightrec_dumps_byte_identical_across_reruns(tmp_path):
+    """Same scenario + same seed + fresh recorders -> byte-identical
+    dump files (names and contents; ``wall_time_s`` is None in sims)."""
+    dir_a, dir_b = str(tmp_path / "a"), str(tmp_path / "b")
+    os.makedirs(dir_a)
+    os.makedirs(dir_b)
+    _run_failing(dir_a)
+    _run_failing(dir_b)
+    names_a = sorted(
+        f for f in os.listdir(dir_a) if f.startswith("flightrec_")
+    )
+    names_b = sorted(
+        f for f in os.listdir(dir_b) if f.startswith("flightrec_")
+    )
+    assert names_a == names_b and len(names_a) > 0
+    for name in names_a:
+        with open(os.path.join(dir_a, name), "rb") as f:
+            blob_a = f.read()
+        with open(os.path.join(dir_b, name), "rb") as f:
+            blob_b = f.read()
+        assert blob_a == blob_b, f"{name} differs across identical reruns"
+        assert json.loads(blob_a)["wall_time_s"] is None
+
+
+def test_green_verdict_carries_null_dump_key(tmp_path):
+    """Every chaos verdict exposes ``flightrec_dump`` — None on success —
+    so downstream tooling never KeyErrors on the happy path."""
+    from tpu_swirld.chaos import ChaosScenario, ChaosSimulation
+
+    sim = ChaosSimulation(
+        ChaosScenario(n_nodes=4, n_turns=60, seed=1, checkpoint_every=30),
+        str(tmp_path / "ckpt"),
+        flightrec=FlightRecorder(dump_dir=str(tmp_path)),
+    )
+    verdict = sim.run()
+    assert verdict["ok"]
+    assert verdict["flightrec_dump"] is None
+    assert not [
+        f for f in os.listdir(tmp_path) if f.startswith("flightrec_")
+    ]
+
+
+def test_trigger_without_dump_dir_records_in_memory_only():
+    rec = FlightRecorder(dump_dir=None)
+    assert rec.trigger("rebase_storm", node="s", detail={"x": 1}) is None
+    assert rec.trigger_counts["rebase_storm"] == 1
+
+
+def test_load_dump_rejects_foreign_json(tmp_path):
+    p = tmp_path / "not_a_dump.json"
+    p.write_text('{"schema": "something-else/9"}')
+    with pytest.raises(ValueError):
+        load_dump(str(p))
+
+
+# --------------------------------------------- exporter golden formats
+
+
+def test_prometheus_histogram_exposition_golden():
+    """Scrape-valid histogram rendering: cumulative ``_bucket`` lines
+    with ``le`` upper bounds, the implicit ``+Inf`` bucket, and the
+    ``_sum`` / ``_count`` pair — pinned byte-for-byte."""
+    reg = Registry()
+    h = reg.histogram("lat_seconds", {"stage": "x"}, buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    assert reg.to_prometheus_text() == (
+        "# TYPE lat_seconds histogram\n"
+        'lat_seconds_bucket{stage="x",le="0.1"} 1\n'
+        'lat_seconds_bucket{stage="x",le="1.0"} 2\n'
+        'lat_seconds_bucket{stage="x",le="+Inf"} 3\n'
+        'lat_seconds_sum{stage="x"} 5.55\n'
+        'lat_seconds_count{stage="x"} 3\n'
+    )
+
+
+def test_prometheus_label_escaping_keeps_one_sample_per_line():
+    """Backslash, quote, and NEWLINE must all escape — a raw newline in
+    a label value would split the sample line and break the scrape."""
+    reg = Registry()
+    reg.gauge("g", {"msg": 'a"b\\c\nd'}).set(1)
+    text = reg.to_prometheus_text()
+    lines = text.splitlines()
+    assert len(lines) == 2                      # TYPE header + one sample
+    assert lines[1] == 'g{msg="a\\"b\\\\c\\nd"} 1'
+
+
+def test_finality_histograms_land_in_registry():
+    reg = Registry()
+    fin = FinalityTracker("streaming", clock=lambda: 7.0, registry=reg)
+    fin.record_decided(0, 2, 4, birth=3.0, phase="window")
+    fin.set_watermark("s0", 1, 3)
+    assert reg.value("finality_rounds_to_decision",
+                     {"engine": "streaming"}) == 1
+    assert reg.value("finality_time_to_finality",
+                     {"engine": "streaming", "phase": "window"}) == 1
+    assert reg.value("finality_decided_watermark", {"node": "s0"}) == 1
+
+
+# -------------------------------------------------- bench_compare gating
+
+
+def test_bench_compare_gates_finality_latency_lower_is_better():
+    import scripts.bench_compare as bc
+
+    old = {"value": 100.0,
+           "finality": {"incremental": {"ttf_p99": 1.0, "rtd_mean": 2.0}}}
+    worse = {"value": 100.0,
+             "finality": {"incremental": {"ttf_p99": 1.25, "rtd_mean": 2.0}}}
+    failures, _ = bc.compare(old, worse, "value", 0.10)
+    assert any("finality.incremental.ttf_p99" in f for f in failures)
+    failures, _ = bc.compare(old, old, "value", 0.10)
+    assert failures == []
+
+
+# ----------------------------------------------------- lint-scope pinning
+
+
+@pytest.mark.parametrize("module", ["obs/finality.py", "obs/flightrec.py"])
+def test_sw002_scope_covers_obs_modules(module):
+    """The new obs modules iterate consensus-adjacent state; the
+    unordered-iteration rule must apply to them."""
+    from tpu_swirld.analysis import check_source
+
+    bad = 's = {b"a", b"b"}\nfor x in s:\n    pass\n'
+    findings = check_source(bad, module_path=module)
+    assert "SW002" in [f.rule for f in findings]
+
+
+@pytest.mark.parametrize("module", ["obs/finality.py", "obs/flightrec.py"])
+def test_sw003_scope_covers_obs_modules(module):
+    """Clock discipline: the trackers/recorder take injected clocks and
+    must never read wall time themselves (byte-stable sim dumps)."""
+    from tpu_swirld.analysis import check_source
+
+    bad = "import time\n\ndef f():\n    return time.time()\n"
+    findings = check_source(bad, module_path=module)
+    assert "SW003" in [f.rule for f in findings]

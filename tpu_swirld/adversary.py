@@ -311,6 +311,10 @@ def _with_engines(sim: ChaosSimulation, verdict: Dict, engine) -> Dict:
             for r in rows
         )
     )
+    # the parity fold can flip a green run() verdict red: a red verdict
+    # must still carry its flight-recorder bundle
+    if not verdict["ok"] and not verdict.get("flightrec_dump"):
+        verdict["flightrec_dump"] = sim.flightrec_postmortem(verdict)
     return verdict
 
 
@@ -331,7 +335,7 @@ def register_scenario(name: str):
 @register_scenario("equivocation_storm")
 def run_equivocation_storm(
     ckpt_dir: str, seed: Optional[int] = None, engine: str = "incremental",
-    metrics=None, tracer=None,
+    metrics=None, tracer=None, flightrec=None,
 ) -> Dict:
     """One storm forker (within the f=(n-1)//3 budget for n=5) minting
     fork pairs every other turn through a 110-turn window.  Verdict:
@@ -347,7 +351,10 @@ def run_equivocation_storm(
         },
         attack_end=120,
     )
-    sim = ChaosSimulation(scenario, ckpt_dir, metrics=metrics, tracer=tracer)
+    sim = ChaosSimulation(
+        scenario, ckpt_dir, metrics=metrics, tracer=tracer,
+        flightrec=flightrec,
+    )
     verdict = sim.run()
     adv = _honest_counters(sim)
     adv["strategy"] = "equivocation_storm"
@@ -363,7 +370,7 @@ def run_equivocation_storm(
 @register_scenario("censorship")
 def run_censorship(
     ckpt_dir: str, seed: Optional[int] = None, engine: str = "incremental",
-    metrics=None, tracer=None,
+    metrics=None, tracer=None, flightrec=None,
 ) -> Dict:
     """A relay censors member 1's events out of its replies for 100
     turns.  Safety/liveness must hold (the victim's events reach peers
@@ -379,7 +386,10 @@ def run_censorship(
         },
         attack_end=120,
     )
-    sim = ChaosSimulation(scenario, ckpt_dir, metrics=metrics, tracer=tracer)
+    sim = ChaosSimulation(
+        scenario, ckpt_dir, metrics=metrics, tracer=tracer,
+        flightrec=flightrec,
+    )
     verdict = sim.run()
     adv = _honest_counters(sim)
     adv["strategy"] = "censorship"
@@ -391,7 +401,7 @@ def run_censorship(
 @register_scenario("delayed_release")
 def run_delayed_release(
     ckpt_dir: str, seed: Optional[int] = None, engine: str = "incremental",
-    metrics=None, tracer=None,
+    metrics=None, tracer=None, flightrec=None,
 ) -> Dict:
     """A straggler holds its own events for ~110 turns — long past the
     honest frozen vote horizon — then releases the tail.  The released
@@ -408,7 +418,10 @@ def run_delayed_release(
         },
         attack_end=140,
     )
-    sim = ChaosSimulation(scenario, ckpt_dir, metrics=metrics, tracer=tracer)
+    sim = ChaosSimulation(
+        scenario, ckpt_dir, metrics=metrics, tracer=tracer,
+        flightrec=flightrec,
+    )
     verdict = sim.run()
     adv = _honest_counters(sim)
     adv["strategy"] = "delayed_release"
@@ -423,7 +436,7 @@ def run_delayed_release(
 
 def _run_fork_bomb(
     ckpt_dir: str, seed: int, engine: str, n_forkers: int,
-    metrics=None, tracer=None,
+    metrics=None, tracer=None, flightrec=None,
 ):
     n_nodes = 7
     scenario = ChaosScenario(
@@ -438,7 +451,10 @@ def _run_fork_bomb(
         },
         attack_end=130,
     )
-    sim = ChaosSimulation(scenario, ckpt_dir, metrics=metrics, tracer=tracer)
+    sim = ChaosSimulation(
+        scenario, ckpt_dir, metrics=metrics, tracer=tracer,
+        flightrec=flightrec,
+    )
     verdict = sim.run()
     adv = _honest_counters(sim)
     adv["n_forkers"] = n_forkers
@@ -450,7 +466,7 @@ def _run_fork_bomb(
 @register_scenario("fork_bomb")
 def run_fork_bomb(
     ckpt_dir: str, seed: Optional[int] = None, engine: str = "incremental",
-    metrics=None, tracer=None,
+    metrics=None, tracer=None, flightrec=None,
 ) -> Dict:
     """Coordinated equivocation at exactly f = (n-1)//3 creators (n=7,
     f=2): the protocol's design point.  Honest nodes must survive —
@@ -458,7 +474,8 @@ def run_fork_bomb(
     flags (the admission check must not cry wolf at the bound)."""
     seed = 2 if seed is None else seed
     verdict, sim = _run_fork_bomb(
-        ckpt_dir, seed, engine, n_forkers=2, metrics=metrics, tracer=tracer
+        ckpt_dir, seed, engine, n_forkers=2, metrics=metrics, tracer=tracer,
+        flightrec=flightrec,
     )
     adv = verdict["adversary"]
     adv["strategy"] = "fork_bomb"
@@ -473,7 +490,7 @@ def run_fork_bomb(
 @register_scenario("fork_bomb_overbudget")
 def run_fork_bomb_overbudget(
     ckpt_dir: str, seed: Optional[int] = None, engine: str = "incremental",
-    metrics=None, tracer=None,
+    metrics=None, tracer=None, flightrec=None,
 ) -> Dict:
     """Coordinated equivocation at f+1 creators — OUTSIDE the n > 3f
     model.  The obligation is detection, not tolerance: every honest
@@ -484,7 +501,8 @@ def run_fork_bomb_overbudget(
     what actually happened."""
     seed = 2 if seed is None else seed
     verdict, sim = _run_fork_bomb(
-        ckpt_dir, seed, engine, n_forkers=3, metrics=metrics, tracer=tracer
+        ckpt_dir, seed, engine, n_forkers=3, metrics=metrics, tracer=tracer,
+        flightrec=flightrec,
     )
     adv = verdict["adversary"]
     adv["strategy"] = "fork_bomb_overbudget"
@@ -494,13 +512,15 @@ def run_fork_bomb_overbudget(
     )
     adv["silent_divergence"] = bool(diverged and not flagged)
     verdict["ok"] = bool(flagged and not adv["silent_divergence"])
+    if not verdict["ok"] and not verdict.get("flightrec_dump"):
+        verdict["flightrec_dump"] = sim.flightrec_postmortem(verdict)
     return verdict
 
 
 @register_scenario("horizon_storm")
 def _run_horizon_storm(
     ckpt_dir: str, seed: Optional[int] = None, engine: str = "incremental",
-    metrics=None, tracer=None,
+    metrics=None, tracer=None, flightrec=None,
 ) -> Dict:
     """Straggler witnesses across a healing partition: late tails must
     land below the committed frontier with cross-engine bit-parity."""
@@ -508,17 +528,19 @@ def _run_horizon_storm(
 
     return run_horizon_storm(
         ckpt_dir, seed=1 if seed is None else seed, metrics=metrics,
-        tracer=tracer, engine=engine,
+        tracer=tracer, engine=engine, flightrec=flightrec,
     )
 
 
 @register_scenario("overflow_storm")
 def _run_overflow_storm(
     ckpt_dir: str, seed: Optional[int] = None, engine: str = "incremental",
-    metrics=None, tracer=None,
+    metrics=None, tracer=None, flightrec=None,
 ) -> Dict:
     """Witness-table self-healing: fork-storm slot doubling and the
     unclamped round-window retry must finish with oracle parity."""
     from tpu_swirld.chaos import run_overflow_storm
 
-    return run_overflow_storm(seed=4 if seed is None else seed)
+    return run_overflow_storm(
+        seed=4 if seed is None else seed, flightrec=flightrec
+    )

@@ -176,7 +176,7 @@ class UnorderedIterRule(Rule):
     )
     scope = (
         "oracle/", "store/streaming.py", "tpu/pipeline.py", "chaos.py",
-        "adversary.py",
+        "adversary.py", "obs/finality.py", "obs/flightrec.py",
     )
 
     _FIX = (
@@ -307,7 +307,12 @@ class WallClockRule(Rule):
         "the transport/retry layer is logical-time (RetryPolicy ticks); "
         "wall-clock reads and sleeps diverge across nodes and replays"
     )
-    scope = ("transport.py", "oracle/node.py")
+    # finality.py / flightrec.py take injected-clock callables and must
+    # never read wall time themselves (byte-stable sim dumps depend on it)
+    scope = (
+        "transport.py", "oracle/node.py", "obs/finality.py",
+        "obs/flightrec.py",
+    )
 
     _FIX = (
         "in the logical-time transport/retry layer; fix: advance the "

@@ -111,6 +111,8 @@ class ChaosSimulation:
         metrics=None,
         tracer=None,
         on_turn: Optional[Callable[[int, "ChaosSimulation"], None]] = None,
+        flightrec=None,
+        finality: Optional[bool] = None,
     ):
         sc = scenario
         byz = sc.byzantine_indices()
@@ -132,6 +134,10 @@ class ChaosSimulation:
         os.makedirs(ckpt_dir, exist_ok=True)
         self.metrics = metrics
         self.tracer = tracer
+        self.flightrec = flightrec
+        # per-node finality trackers default on whenever metrics flow (the
+        # histograms land in the same registry the verdict exports from)
+        self.finality = bool(metrics) if finality is None else bool(finality)
         self.config = sc.config()
         pop = build_population(
             sc.n_nodes, sc.seed,
@@ -192,7 +198,10 @@ class ChaosSimulation:
             config=self._node_config(i), clock=lambda: self.clock[0],
             network_want=self.network_want, transport=self.transport,
         )
-        attach_obs(node, self.metrics, self.tracer)
+        attach_obs(
+            node, self.metrics, self.tracer, finality=self.finality,
+            flightrec=self.flightrec, label=f"n{i}",
+        )
         self.network[pk] = node.ask_sync
         self.network_want[pk] = node.ask_events
         return node
@@ -223,7 +232,10 @@ class ChaosSimulation:
             network_want=self.network_want, clock=lambda: self.clock[0],
             transport=self.transport,
         )
-        attach_obs(node, self.metrics, self.tracer)
+        attach_obs(
+            node, self.metrics, self.tracer, finality=self.finality,
+            flightrec=self.flightrec, label=f"n{i}",
+        )
         self.transport.set_up(pk)
         self.network[pk] = node.ask_sync
         self.network_want[pk] = node.ask_events
@@ -298,6 +310,11 @@ class ChaosSimulation:
                 node.consensus_pass(new_ids)
                 if node.head != prev_head:
                     wal.append(node.hg[node.head])
+                if self.flightrec is not None:
+                    self.flightrec.record_turn(
+                        f"n{ni}", turn, decided=len(node.consensus),
+                        new=len(new_ids),
+                    )
             if sc.n_forkers and turn % max(1, sc.fork_every) == 0:
                 for f in self.forkers:
                     f.step(honest_pks)
@@ -313,9 +330,44 @@ class ChaosSimulation:
         for idx, node in list(self.nodes.items()):
             if node is None:
                 self._restore(idx)
-        return self.verdict()
+        v = self.verdict()
+        v["flightrec_dump"] = self.flightrec_postmortem(v)
+        return v
 
     # ------------------------------------------------------------ verdict
+
+    def decided_frontier(self) -> Dict[str, Dict[str, int]]:
+        """Per-node decided state (what a post-mortem must pin: the
+        consensus watermark, last committed round, and store size of
+        every live honest member at dump time)."""
+        out: Dict[str, Dict[str, int]] = {}
+        for i, n in sorted(self.nodes.items()):
+            if n is None:
+                continue
+            out[f"n{i}"] = {
+                "decided": len(n.consensus),
+                "consensus_round": n.consensus_round,
+                "events": len(n.hg),
+            }
+        return out
+
+    def flightrec_postmortem(self, verdict: Dict) -> Optional[str]:
+        """Fire the black-box on a red verdict.  Returns the dump path
+        (``None`` when the verdict is green, no recorder is attached, or
+        the recorder has no ``dump_dir``)."""
+        if self.flightrec is None or verdict.get("ok"):
+            return None
+        return self.flightrec.trigger(
+            "verdict_failed",
+            detail={
+                "safety": verdict.get("safety"),
+                "liveness": verdict.get("liveness"),
+            },
+            decided_frontier=self.decided_frontier(),
+            registry=(
+                self.metrics.registry if self.metrics is not None else None
+            ),
+        )
 
     def oracle_order(self) -> List[bytes]:
         """Fault-free ground truth: a fresh observer replays the union of
@@ -424,11 +476,13 @@ class ChaosSimulation:
 
 
 def run_chaos(
-    scenario: ChaosScenario, ckpt_dir: str, metrics=None, tracer=None
+    scenario: ChaosScenario, ckpt_dir: str, metrics=None, tracer=None,
+    flightrec=None,
 ) -> Dict:
     """Build + run one scenario; returns the verdict dict."""
     return ChaosSimulation(
-        scenario, ckpt_dir, metrics=metrics, tracer=tracer
+        scenario, ckpt_dir, metrics=metrics, tracer=tracer,
+        flightrec=flightrec,
     ).run()
 
 
@@ -546,7 +600,8 @@ def horizon_storm_scenario(seed: int = 1, n_turns: int = 260) -> ChaosScenario:
 
 
 def run_horizon_storm(ckpt_dir: str, seed: int = 1, metrics=None,
-                      tracer=None, engine: str = "incremental") -> Dict:
+                      tracer=None, engine: str = "incremental",
+                      flightrec=None) -> Dict:
     """Run the straggler-witness scenario and extend the verdict with the
     horizon section: late-witness counts and cross-engine agreement.  The
     old node-local quarantine made exactly this history a documented
@@ -585,7 +640,7 @@ def run_horizon_storm(ckpt_dir: str, seed: int = 1, metrics=None,
 
     sim = ChaosSimulation(
         scenario, ckpt_dir, metrics=metrics, tracer=tracer,
-        on_turn=_fire_stragglers,
+        on_turn=_fire_stragglers, flightrec=flightrec,
     )
     verdict = sim.run()
     nodes = sim._live_honest()
@@ -605,10 +660,14 @@ def run_horizon_storm(ckpt_dir: str, seed: int = 1, metrics=None,
         and engines["batch_oracle_parity"]
         and engines["incremental_batch_parity"]
     )
+    # the horizon fold can flip a green run() verdict red — make sure a
+    # red verdict still ships its forensic bundle
+    if not verdict["ok"] and not verdict.get("flightrec_dump"):
+        verdict["flightrec_dump"] = sim.flightrec_postmortem(verdict)
     return verdict
 
 
-def run_overflow_storm(seed: int = 4) -> Dict:
+def run_overflow_storm(seed: int = 4, flightrec=None) -> Dict:
     """Device-engine self-healing verdict, two legs:
 
     - *fork storm*: a heavily equivocating DAG run with a deliberately
@@ -680,11 +739,24 @@ def run_overflow_storm(seed: int = 4) -> Dict:
         and clamp_leg["parity"] and clamp_leg["overflow_retries"] >= 1
         and clamp_leg["max_round"] >= 8
     )
+    dump = None
+    if flightrec is not None and not ok:
+        # no live simulation here — the frontier is the two legs' replay
+        # endpoints (oracle watermark and batch order length per leg)
+        dump = flightrec.trigger(
+            "verdict_failed",
+            detail={"fork_storm": fork_leg, "round_clamp": clamp_leg},
+            decided_frontier={
+                "fork_storm": {"decided": len(oracle.consensus)},
+                "round_clamp": {"decided": len(node.consensus)},
+            },
+        )
     return {
         "ok": ok,
         "fork_storm": fork_leg,
         "round_clamp": clamp_leg,
         "scenario": {"seed": seed, "name": "overflow_storm"},
+        "flightrec_dump": dump,
     }
 
 

@@ -10,7 +10,9 @@ Archive knobs additionally honor ``SWIRLD_ARCHIVE_*`` environment
 variables so a deployment can retune the background spill pipeline
 without touching code: an explicit ``SwirldConfig`` field wins, then the
 environment variable, then the built-in default (see
-:func:`resolve_archive_settings`).
+:func:`resolve_archive_settings`).  The flight-recorder knobs
+(``SWIRLD_FLIGHTREC_*``, :func:`resolve_flightrec_settings`) follow the
+same precedence.
 """
 
 from __future__ import annotations
@@ -28,6 +30,38 @@ _ARCHIVE_ENV = {
         lambda v: v.strip().lower() not in ("0", "", "no", "false", "off"),
     ),
 }
+
+
+#: built-in flight-recorder defaults (field -> (env var, default, parser)).
+#: Same precedence as the archive knobs: explicit SwirldConfig field >
+#: SWIRLD_FLIGHTREC_* env var > built-in default.
+_FLIGHTREC_ENV = {
+    "flightrec_capacity": ("SWIRLD_FLIGHTREC_CAPACITY", 256, int),
+    "flightrec_max_dumps": ("SWIRLD_FLIGHTREC_MAX_DUMPS", 16, int),
+    "flightrec_dir": ("SWIRLD_FLIGHTREC_DIR", None, str),
+}
+
+
+def resolve_flightrec_settings(
+    config: Optional["SwirldConfig"] = None,
+) -> Dict:
+    """Concrete flight-recorder settings: explicit config field >
+    ``SWIRLD_FLIGHTREC_*`` env var > built-in default.  Returns
+    ``{"capacity", "max_dumps", "dump_dir"}`` (``dump_dir`` may be
+    ``None`` = record in memory, never auto-dump)."""
+    out = {}
+    names = {
+        "flightrec_capacity": "capacity",
+        "flightrec_max_dumps": "max_dumps",
+        "flightrec_dir": "dump_dir",
+    }
+    for field, (env, default, parse) in _FLIGHTREC_ENV.items():
+        v = getattr(config, field, None) if config is not None else None
+        if v is None:
+            raw = os.environ.get(env)
+            v = parse(raw) if raw is not None else default
+        out[names[field]] = v
+    return out
 
 
 def resolve_archive_settings(config: Optional["SwirldConfig"] = None) -> Dict:
@@ -127,6 +161,17 @@ class SwirldConfig:
                                                   # are identical either way —
                                                   # drain barriers serialize
                                                   # every read)
+
+    # --- black-box flight recorder (obs.flightrec) ---
+    # None = fall back to SWIRLD_FLIGHTREC_* env var, then built-in
+    # default (resolve_flightrec_settings).
+    flightrec_capacity: Optional[int] = None  # ring entries kept per node
+                                              # (default 256)
+    flightrec_max_dumps: Optional[int] = None  # post-mortem dump files per
+                                               # recorder before triggers
+                                               # stop writing (default 16)
+    flightrec_dir: Optional[str] = None       # dump directory; None =
+                                              # in-memory only, no files
 
     def stakes(self) -> Tuple[int, ...]:
         if self.stake is not None:

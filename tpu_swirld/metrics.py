@@ -128,6 +128,10 @@ def node_gauges(
         "backoff_total": getattr(node, "backoff_total", 0.0),
         "quarantined_peers": getattr(node, "quarantined_peers", 0),
         "circuit_opens": getattr(node, "circuit_opens", 0),
+        # finality surface: the decided frontier (consensus length) and
+        # the last round whose order is committed
+        "decided_watermark": len(getattr(node, "consensus", ())),
+        "decided_round": getattr(node, "consensus_round", 0) - 1,
     }
     if registry is not None:
         if node_label is None:
@@ -136,6 +140,14 @@ def node_gauges(
         labels = {"node": node_label} if node_label is not None else None
         for k, v in gauges.items():
             registry.gauge(f"node_{k}", labels).set(v)
+        # also published under the finality_* family so the report CLI's
+        # finality section shows per-node watermarks without node_ noise
+        registry.gauge("finality_decided_watermark", labels).set(
+            gauges["decided_watermark"]
+        )
+        registry.gauge("finality_decided_round", labels).set(
+            gauges["decided_round"]
+        )
     return gauges
 
 

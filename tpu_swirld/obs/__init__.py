@@ -12,6 +12,13 @@ process"); this package is the real implementation the ad-hoc
   Prometheus-text and JSON exporters.
 - :mod:`tpu_swirld.obs.report` — the ``python -m tpu_swirld.obs report``
   CLI rendering a phase-breakdown table + protocol gauges from a trace.
+- :mod:`tpu_swirld.obs.finality` — per-event lifecycle tracking:
+  rounds-to-decision / time-to-finality histograms, decided watermarks,
+  gossip-propagation latency (``finality_*`` metric families).
+- :mod:`tpu_swirld.obs.flightrec` — the black-box flight recorder:
+  bounded per-node rings of recent activity, dumped as self-contained
+  post-mortem JSON when a verdict fails / breaker opens / overflow heals
+  / rebase storm triggers (``flightrec_*`` metric families).
 
 Instrumented layers: oracle phases (``oracle/node.py::consensus_pass``),
 gossip (sync round-trips / payload bytes / events-per-sync / fork
@@ -48,6 +55,12 @@ import contextlib
 import time
 from typing import Optional
 
+from tpu_swirld.obs.finality import (  # noqa: F401
+    FinalityTracker, record_batch_result,
+)
+from tpu_swirld.obs.flightrec import (  # noqa: F401
+    FlightRecorder, load_dump,
+)
 from tpu_swirld.obs.memory import (  # noqa: F401
     MemoryMonitor, device_live_bytes,
 )

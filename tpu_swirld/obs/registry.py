@@ -47,8 +47,14 @@ def _label_key(labels: LabelDict) -> Tuple[Tuple[str, str], ...]:
 def _render_labels(items: Tuple[Tuple[str, str], ...]) -> str:
     if not items:
         return ""
+    # Prometheus text exposition: label values escape backslash, quote,
+    # AND newline (a raw newline would split the sample line and break
+    # the scrape)
     body = ",".join(
-        '%s="%s"' % (k, v.replace("\\", "\\\\").replace('"', '\\"'))
+        '%s="%s"' % (
+            k,
+            v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n"),
+        )
         for k, v in items
     )
     return "{%s}" % body

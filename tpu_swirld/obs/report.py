@@ -8,7 +8,10 @@ and prints:
    and percent of the total traced depth-0 time, nested names indented by
    their recorded depth;
 2. the *protocol gauges* — every counter sample (``ph: "C"``) embedded in
-   the trace, i.e. the registry snapshot at save time.
+   the trace, i.e. the registry snapshot at save time — split into
+   protocol / store / finality (rounds-to-decision, time-to-finality,
+   decided watermarks) / flight-recorder (trigger + dump counters) /
+   resilience sections.
 
 Pure stdlib + pure functions over the event list, so the CLI can be smoke-
 tested cheaply (``tests/test_obs.py``) and never rots silently.
@@ -115,6 +118,24 @@ def is_store_row(g: Dict) -> bool:
     return any(g["name"].startswith(p) for p in _STORE_PREFIXES)
 
 
+# The finality lifecycle surface: rounds-to-decision / time-to-finality
+# histogram rows (per engine, with the streaming phase dimension),
+# gossip-propagation latency, and per-node decided-watermark gauges.
+_FINALITY_PREFIXES = ("finality_",)
+
+# The black-box flight recorder: trigger counters by reason and the
+# dump/record totals stamped at dump time.
+_FLIGHTREC_PREFIXES = ("flightrec_",)
+
+
+def is_finality_row(g: Dict) -> bool:
+    return any(g["name"].startswith(p) for p in _FINALITY_PREFIXES)
+
+
+def is_flightrec_row(g: Dict) -> bool:
+    return any(g["name"].startswith(p) for p in _FLIGHTREC_PREFIXES)
+
+
 def render_report(events: List[Dict]) -> str:
     spans = aggregate_spans(events)
     gauges = gauge_rows(events)
@@ -144,9 +165,20 @@ def render_report(events: List[Dict]) -> str:
         g for g in gauges
         if is_store_row(g) and not is_resilience_row(g)
     ]
+    finality = [
+        g for g in gauges
+        if is_finality_row(g)
+        and not is_resilience_row(g) and not is_store_row(g)
+    ]
+    flightrec = [
+        g for g in gauges
+        if is_flightrec_row(g)
+        and not is_resilience_row(g) and not is_store_row(g)
+    ]
     protocol = [
         g for g in gauges
         if not is_resilience_row(g) and not is_store_row(g)
+        and not is_finality_row(g) and not is_flightrec_row(g)
     ]
     lines.append("")
     lines.append("== protocol gauges ==")
@@ -161,6 +193,19 @@ def render_report(events: List[Dict]) -> str:
         lines.append("== store (tile budget / archive / spill overlap) ==")
         width = max(len(_gauge_name(g)) for g in store)
         for g in store:
+            lines.append(f"{_gauge_name(g):<{width}}  {g['value']}")
+    if finality:
+        lines.append("")
+        lines.append("== finality (rounds-to-decision / time-to-finality"
+                     " / watermarks) ==")
+        width = max(len(_gauge_name(g)) for g in finality)
+        for g in finality:
+            lines.append(f"{_gauge_name(g):<{width}}  {g['value']}")
+    if flightrec:
+        lines.append("")
+        lines.append("== flight recorder (triggers / dumps) ==")
+        width = max(len(_gauge_name(g)) for g in flightrec)
+        for g in flightrec:
             lines.append(f"{_gauge_name(g):<{width}}  {g['value']}")
     if resilience:
         lines.append("")

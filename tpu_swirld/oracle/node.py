@@ -141,6 +141,11 @@ class Node:
                                           # walk hit max_fork_branches
         self.metrics = None   # set to metrics.Metrics() to enable counters
         self.tracer = None    # set to obs.Tracer() to record phase spans
+        self.finality = None  # set to obs.FinalityTracker for per-event
+                              # lifecycle tracking (rounds-to-decision,
+                              # time-to-finality, gossip propagation)
+        self.flightrec = None       # set via obs.flightrec.wire_node
+        self.flightrec_label = None  # ring key for this node's entries
         self._tpu_engine = None   # lazily built when config.backend == "tpu"
         self.members: List[bytes] = list(members)
         self.member_index: Dict[bytes, int] = {m: i for i, m in enumerate(members)}
@@ -339,6 +344,12 @@ class Node:
         if c == self.pk:
             self.head = eid
         self.tbd.append(eid)
+        if c != self.pk and self.finality is not None:
+            # first remote arrival: creation stamp -> local tick is the
+            # gossip-propagation latency (deduped inside the tracker)
+            self.finality.record_gossip_arrival(eid, ev.t, now=self._clock())
+        if self.flightrec is not None:
+            self.flightrec.record_ingest(self.flightrec_label, eid)
         return True
 
     def _on_fork_group(self, c: bytes, s: int, group: List[bytes]) -> None:
@@ -1197,9 +1208,25 @@ class Node:
                     remaining.append(x)
             self.tbd = remaining
             received.sort(key=lambda item: (item[0], item[1]))
+            fin = self.finality
+            now = self._clock() if fin is not None else None
             for med, _tie, x in received:
                 self.consensus.append(x)
                 self.transactions.append(self.hg[x].d)
+                if fin is not None:
+                    # rounds_to_decision = round_received - round is a pure
+                    # DAG function; birth is the event's creation stamp, so
+                    # time_to_finality is logical ticks under a sim clock
+                    fin.record_decided(
+                        x, self.round[x], r, birth=self.hg[x].t, now=now,
+                    )
+            if fin is not None and received:
+                fin.set_watermark(
+                    self.flightrec_label
+                    if self.flightrec_label is not None
+                    else self.pk[:4].hex(),
+                    len(self.consensus), r,
+                )
 
     # ------------------------------------------------------------- main loop
 

@@ -424,6 +424,7 @@ class MeshStreamingConsensus(StreamingConsensus):
                 device_budget_tiles=device_tile_budget,
             )
         super().__init__(members, stake, config, store=store, **kw)
+        self.flightrec_label = "streaming-mesh"
 
     # ----------------------------------------------------------- placement
 

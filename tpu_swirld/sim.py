@@ -21,7 +21,10 @@ from tpu_swirld.oracle.node import Node
 from tpu_swirld.transport import Transport
 
 
-def attach_obs(node: Node, metrics=None, tracer=None) -> None:
+def attach_obs(
+    node: Node, metrics=None, tracer=None, finality=None, flightrec=None,
+    label: Optional[str] = None,
+) -> None:
     """Wire observability into one node.
 
     ``metrics``: a shared :class:`~tpu_swirld.metrics.Metrics` instance
@@ -30,11 +33,41 @@ def attach_obs(node: Node, metrics=None, tracer=None) -> None:
     :class:`~tpu_swirld.obs.Tracer` shared by every node it is given to
     (spans carry no node id — pass one tracer per node for per-node
     timelines), or ``None``.
+
+    ``finality``: ``True`` builds a per-node
+    :class:`~tpu_swirld.obs.finality.FinalityTracker` on the node's own
+    logical clock (engine ``"oracle"``, registry shared via ``metrics``
+    when given), or pass a prebuilt tracker.  Trackers are per-node state
+    (gossip first-arrival dedup, decided watermarks) even when they share
+    one registry.  ``flightrec``: a shared
+    :class:`~tpu_swirld.obs.flightrec.FlightRecorder`; the node's ingest
+    digests land in its ring under ``label`` and the circuit breaker's
+    open transitions fire ``breaker_open`` triggers.  ``label`` defaults
+    to a pk prefix.
     """
+    if label is None:
+        label = "n-" + node.pk[:4].hex()
     if metrics:            # falsy (None/False) means disabled
         node.metrics = Metrics() if metrics is True else metrics
     if tracer:
         node.tracer = tracer
+    if finality:
+        if finality is True:
+            from tpu_swirld.obs.finality import FinalityTracker
+
+            registry = (
+                node.metrics.registry
+                if node.metrics is not None else None
+            )
+            finality = FinalityTracker(
+                "oracle", clock=node._clock, registry=registry,
+            )
+        node.finality = finality
+        node.flightrec_label = label
+    if flightrec:
+        from tpu_swirld.obs.flightrec import wire_node
+
+        wire_node(node, flightrec, label)
 
 
 @dataclasses.dataclass
@@ -137,6 +170,8 @@ def make_simulation(
     metrics=None,
     tracer=None,
     transport_factory: Optional[Callable] = None,
+    finality=None,
+    flightrec=None,
 ) -> Simulation:
     """Build keypairs, the shared network dict, and N nodes (the reference's
     ``test(n_nodes, n_turns)`` setup).
@@ -144,7 +179,11 @@ def make_simulation(
     ``metrics=`` / ``tracer=`` (see :func:`attach_obs`) wire gossip counters
     and phase spans into every node at construction time — no post-hoc
     patching.  Pass one shared ``Metrics`` to aggregate the population's
-    gossip traffic into a single registry.
+    gossip traffic into a single registry.  ``finality=True`` gives every
+    node its own lifecycle tracker on the shared logical clock (merged
+    into the ``metrics`` registry when given); ``flightrec=`` shares one
+    :class:`~tpu_swirld.obs.flightrec.FlightRecorder` across the
+    population (rings keyed ``n0..n{N-1}``).
 
     ``transport_factory(network, network_want, members, clock)`` builds the
     shared delivery layer (default: the reliable in-process
@@ -158,7 +197,7 @@ def make_simulation(
     pop = build_population(n_nodes, seed, transport_factory)
     clock = pop.clock
     nodes: List[Node] = []
-    for pk, sk in pop.keys:
+    for i, (pk, sk) in enumerate(pop.keys):
         node = Node(
             sk=sk,
             pk=pk,
@@ -169,7 +208,10 @@ def make_simulation(
             network_want=pop.network_want,
             transport=pop.transport,
         )
-        attach_obs(node, metrics, tracer)
+        attach_obs(
+            node, metrics, tracer, finality=finality, flightrec=flightrec,
+            label=f"n{i}",
+        )
         pop.network[pk] = node.ask_sync
         pop.network_want[pk] = node.ask_events
         nodes.append(node)

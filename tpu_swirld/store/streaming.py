@@ -98,6 +98,13 @@ class StreamingConsensus(IncrementalConsensus):
         self._ingest_chunk = _bucket(max(ingest_chunk, 1), self._chunk)
         self._round_hi = 0          # next global round to ledger-retire
         self._widen_answered = False
+        self.flightrec_label = "streaming"
+        # latency attribution: a pass's decided events are stamped with
+        # how the pass got to decide them — pure window residency
+        # ("window"), an archive-widening rebase ("widened"), or the full
+        # batch fallback ("full"); see IncrementalConsensus._stats
+        self._latency_phase = "window"
+        self._latency_phase_default = "window"
         self.widen_rebases = 0      # rebases answered by window widening
         self.full_rebases = 0       # rebases that paid the batch pass
 
@@ -285,6 +292,7 @@ class StreamingConsensus(IncrementalConsensus):
                     if not need:
                         self.widen_rebases += 1
                         self._widen_answered = True
+                        self._latency_phase = "widened"
                         o = obs.current()
                         if o is not None:
                             o.registry.counter(
@@ -292,6 +300,7 @@ class StreamingConsensus(IncrementalConsensus):
                             ).inc()
                         return ordered
         self.full_rebases += 1
+        self._latency_phase = "full"
         return super()._rebase()
 
     def _widen_target(self) -> Optional[int]:

@@ -2050,6 +2050,18 @@ class IncrementalConsensus:
         self.rebases = 0
         self.recompiles_hint = 0
         self.overflow_heals = 0   # capacity growths absorbed by rebases
+        self.finality = None      # obs.FinalityTracker: per-event
+                                  # lifecycle (births at ingest, decided
+                                  # at commit — see _stats)
+        self.flightrec = None     # obs.FlightRecorder: storm/overflow
+                                  # anomalies dump post-mortems
+        self.flightrec_label = "incremental"
+        # latency-phase attribution: the streaming driver stamps each
+        # pass's decided events with "window" / "widened" / "full"
+        # (window residency vs archive widening); plain incremental
+        # leaves both None (no phase dimension)
+        self._latency_phase = None
+        self._latency_phase_default = None
 
         # rebase-storm guard: adversarial ingest (straggler floods, deep
         # orphan replays) can make EVERY pass detect-then-rebase, paying
@@ -2144,8 +2156,13 @@ class IncrementalConsensus:
         ``window_size``, ``pruned_prefix``, ``rebased``, ``seconds``.
         """
         t0 = time.perf_counter()
+        n_before = len(self.packer)
         self.packer.extend(events)
         n_total = len(self.packer)
+        if self.finality is not None and n_total > n_before:
+            # birth = the tick this ingest chunk entered the driver; the
+            # tracker's clock decides the unit (logical tick vs seconds)
+            self.finality.mark_births(n_before, n_total)
         n_new = n_total - self._n_done
         if n_total == 0 or (n_new == 0 and self._initialized):
             return self._stats(n_new, [], t0, rebased=False)
@@ -2225,6 +2242,22 @@ class IncrementalConsensus:
                 ):
                     self.storm_entries += 1
                     self._storm_left = self.storm_cooldown
+                    if self.flightrec is not None:
+                        oo = obs.current()
+                        self.flightrec.trigger(
+                            "rebase_storm", node=self.flightrec_label,
+                            detail={
+                                "consecutive": self._consec_rebases,
+                                "cooldown": self.storm_cooldown,
+                            },
+                            decided_frontier={
+                                self.flightrec_label: {
+                                    "decided": len(self._order),
+                                    "round": self._consensus_round,
+                                },
+                            },
+                            registry=oo.registry if oo is not None else None,
+                        )
         elif n_new > 0:
             self._consec_rebases = 0   # a clean incremental pass
         # a storm-mode pass must report as such even when it was the last
@@ -2245,6 +2278,21 @@ class IncrementalConsensus:
                 g.counter("incremental_rebases_total").inc()
             if storm:
                 g.counter("incremental_storm_rebases_total").inc()
+        fin = self.finality
+        if fin is not None and ordered:
+            phase = self._latency_phase
+            now = fin.now()
+            for gi in ordered:
+                gi = int(gi)
+                fin.record_decided(
+                    gi, int(self._round_g[gi]), int(self._rr_g[gi]),
+                    now=now, phase=phase,
+                )
+            fin.set_watermark(
+                self.flightrec_label, len(self._order),
+                self._consensus_round - 1,
+            )
+        self._latency_phase = self._latency_phase_default
         return {
             "new_events": int(n_new),
             "ordered": ordered,
@@ -2938,7 +2986,21 @@ class IncrementalConsensus:
         # batch pass grow s_max/r_rounds; the carried window table must
         # match the batch table's slot shape)
         self._s_cap = max(self._s_cap, aux["s_max"])
-        self.overflow_heals += aux["overflow_retries"]
+        heals = int(aux["overflow_retries"])
+        self.overflow_heals += heals
+        if heals and self.flightrec is not None:
+            oo = obs.current()
+            self.flightrec.trigger(
+                "overflow_heal", node=self.flightrec_label,
+                detail={"retries": heals, "s_cap": self._s_cap},
+                decided_frontier={
+                    self.flightrec_label: {
+                        "decided": prev_ordered,
+                        "round": self._consensus_round,
+                    },
+                },
+                registry=oo.registry if oo is not None else None,
+            )
         result = finalize_order(packed, out, ts_unique)
 
         # ---- commit everything the batch pass decided

@@ -391,6 +391,9 @@ class CircuitBreaker:
         self._opened_at: Dict[bytes, float] = {}
         self._probing: set = set()
         self.opens = 0             # lifetime count of open transitions
+        self.on_open: Optional[Callable[[bytes], None]] = None
+        # ^ observer seam: called with the peer on every open transition
+        # (the flight recorder hooks this to dump a post-mortem)
 
     def state(self, peer: bytes) -> str:
         t0 = self._opened_at.get(peer)
@@ -416,6 +419,8 @@ class CircuitBreaker:
         self._misbehavior[peer] = 0
         self._probing.discard(peer)
         self.opens += 1
+        if self.on_open is not None:
+            self.on_open(peer)
 
     def record_failure(self, peer: bytes) -> None:
         if peer in self._opened_at:
