@@ -738,6 +738,107 @@ def run_chaos_overhead():
     print(json.dumps(out), flush=True)
 
 
+def run_cluster():
+    """--cluster: real-process loopback cluster throughput + latency.
+
+    Two legs, one JSON line:
+
+    - **chaos leg** — BENCH_CLUSTER_NODES processes over loopback TCP,
+      client traffic at BENCH_CLUSTER_RATE tx/s, one node SIGKILLed at
+      30% of the window and restarted from checkpoint + WAL at 50%; the
+      verdict (safety vs the oracle replay of the union DAG, liveness
+      past the crash window) must be green, and the line reports
+      ``cluster.{tx_per_s, submit_p50_s, submit_p99_s}`` — decided
+      transactions per second and merged submission→decided wall
+      latency — for bench_compare.py to gate;
+    - **overload leg** — a small cluster with the admission window
+      forced to zero under the same rate: every node must shed
+      (``SHED:window``) rather than queue unboundedly.  ``shed == 0``
+      means backpressure is broken and the bench exits 1.
+
+    Env knobs: BENCH_CLUSTER_NODES (5), BENCH_CLUSTER_DURATION (6.0 s),
+    BENCH_CLUSTER_RATE (300 tx/s), BENCH_CLUSTER_TX_BYTES (64),
+    BENCH_CLUSTER_SEED (9).
+    """
+    import tempfile
+
+    from tpu_swirld.net.cluster import ClusterSpec, run_cluster as _run
+
+    n_nodes = int(os.environ.get("BENCH_CLUSTER_NODES", "5"))
+    duration = float(os.environ.get("BENCH_CLUSTER_DURATION", "6.0"))
+    rate = float(os.environ.get("BENCH_CLUSTER_RATE", "300"))
+    tx_bytes = int(os.environ.get("BENCH_CLUSTER_TX_BYTES", "64"))
+    seed = int(os.environ.get("BENCH_CLUSTER_SEED", "9"))
+    net = {"gossip_interval_s": 0.005, "checkpoint_every_s": 0.5}
+
+    workdir = tempfile.mkdtemp(prefix="swirld-bench-cluster-")
+    log(f"[cluster] {n_nodes} processes, {duration}s @ {rate} tx/s, "
+        f"kill -9 node 1 at {duration * 0.3:.1f}s, "
+        f"restart at {duration * 0.5:.1f}s ({workdir})")
+    verdict = _run(ClusterSpec(
+        workdir=os.path.join(workdir, "chaos"),
+        n_nodes=n_nodes, seed=seed, duration_s=duration,
+        tx_rate=rate, tx_bytes=tx_bytes,
+        kill_index=1, kill_at_s=duration * 0.3,
+        restart_at_s=duration * 0.5,
+        flightrec_dir=os.path.join(workdir, "chaos", "flightrec"),
+        net=net,
+    ))
+    tx = verdict["tx"]
+    log(f"[cluster] ok={verdict['ok']} decided_tx={tx['decided']} "
+        f"({tx['tx_per_s']:.0f} tx/s) p99="
+        f"{tx.get('submit_p99', float('nan')):.3f}s")
+
+    log("[overload] 3 processes, admission window forced to 0 "
+        "(every submission must shed, none may queue)")
+    overload = _run(ClusterSpec(
+        workdir=os.path.join(workdir, "overload"),
+        n_nodes=3, seed=seed + 1, duration_s=min(duration, 3.0),
+        tx_rate=rate, tx_bytes=tx_bytes,
+        net=dict(net, max_undecided=0),
+    ))
+    shed = overload["tx"]["shed"]
+    log(f"[overload] ok={overload['ok']} shed={shed} "
+        f"acked={overload['tx']['acked']}")
+
+    out = {
+        "metric": "cluster_tx_per_s",
+        "value": tx["tx_per_s"],
+        "unit": "decided tx/sec",
+        "platform": "cpu-processes",
+        "cluster": {
+            "tx_per_s": tx["tx_per_s"],
+            "submit_p50_s": tx.get("submit_p50"),
+            "submit_p99_s": tx.get("submit_p99"),
+            "tx_submitted": tx["submitted"],
+            "tx_acked": tx["acked"],
+            "tx_failed": tx["failed"],
+            "tx_decided": tx["decided"],
+            "n_nodes": n_nodes,
+            "duration_s": duration,
+            "rate": rate,
+            "verdict_ok": verdict["ok"],
+            "safety": verdict["safety"],
+            "liveness": verdict["liveness"],
+            "overload_ok": overload["ok"],
+            "overload_shed": shed,
+            "wal_torn_tail_recovered":
+                verdict["counters"]["wal_torn_tail_recovered"],
+        },
+        "lint": lint_stamp(),
+        "mc": mc_stamp(),
+        "scale_audit": scale_audit_stamp(),
+    }
+    print(json.dumps(out), flush=True)
+    if not verdict["ok"] or not overload["ok"]:
+        log("[cluster] FAIL: verdict not green")
+        sys.exit(1)
+    if shed == 0:
+        log("[overload] FAIL: zero submissions shed — backpressure "
+            "is not engaging")
+        sys.exit(1)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument(
@@ -773,8 +874,18 @@ def main(argv=None):
         "chaos_overhead JSON object (BENCH_CHAOS_* overrides); "
         "bench_compare.py gates clean/attack ev/s and their ratio",
     )
+    ap.add_argument(
+        "--cluster", action="store_true",
+        help="run a real-process loopback cluster (socket transport, tx "
+        "ingestion, kill -9 + checkpoint/WAL recovery) and stamp decided "
+        "tx/s + submission→decided p50/p99 into a cluster JSON object "
+        "(BENCH_CLUSTER_* overrides); also runs an overload leg that "
+        "must shed load (exit 1 on any verdict failure or zero sheds)",
+    )
     args = ap.parse_args(argv)
-    if args.chaos_overhead:
+    if args.cluster:
+        run_cluster()
+    elif args.chaos_overhead:
         run_chaos_overhead()
     elif args.stream:
         run_stream(

@@ -81,6 +81,12 @@ EXTRA_KEYS = [
     ("finality.batch.rtd_mean", False),
     ("finality.streaming.ttf_p99", False),
     ("finality.streaming.rtd_mean", False),
+    # real-process cluster artifacts (bench.py --cluster): decided
+    # transactions per second across a 5-process loopback cluster, and
+    # the merged p99 submission→decided wall latency — throughput must
+    # not fall, tail latency must not grow
+    ("cluster.tx_per_s", True),
+    ("cluster.submit_p99_s", False),
 ]
 
 

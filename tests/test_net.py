@@ -1,0 +1,674 @@
+"""The net layer's unit surface: framing, WAL durability, tx ingestion,
+the SW003 justified-suppression scope, and the socket-transport parity
+suite (same schedule over the in-process Transport and a loopback
+SocketTransport must decide bit-identical prefixes).
+
+Everything here runs in-process or over loopback sockets owned by the
+test; the real-process cluster lives in tests/test_cluster.py.
+"""
+
+import os
+import random
+import socket
+import struct
+import threading
+
+import pytest
+
+from tpu_swirld import crypto
+from tpu_swirld.analysis.lint import check_source
+from tpu_swirld.config import SwirldConfig, resolve_net_settings
+from tpu_swirld.net import frame
+from tpu_swirld.net.frame import FrameError, allocate_ports
+from tpu_swirld.net.ingest import TxPool, decode_batch, encode_batch
+from tpu_swirld.net.transport import SocketTransport
+from tpu_swirld.net.wal import MAGIC, TAG_EVENT, OwnEventWal
+from tpu_swirld.net.node_proc import NodeServer, startup_postmortem
+from tpu_swirld.obs.flightrec import FlightRecorder, load_dump
+from tpu_swirld.oracle.event import Event, encode_event
+from tpu_swirld.oracle.node import Node
+from tpu_swirld.transport import (
+    CHANNEL_SYNC, DeliveryTimeout, PeerUnreachable, Transport,
+)
+
+# ------------------------------------------------------------- framing
+
+
+def _pair():
+    a, b = socket.socketpair()
+    a.settimeout(5.0)
+    b.settimeout(5.0)
+    return a, b
+
+
+def test_frame_request_reply_roundtrip():
+    a, b = _pair()
+    try:
+        frame.send_request(a, frame.KIND_SYNC, b"S" * 32, b"payload-bytes")
+        kind, src, payload = frame.recv_request(b)
+        assert (kind, src, payload) == (frame.KIND_SYNC, b"S" * 32,
+                                        b"payload-bytes")
+        frame.send_reply(b, frame.STATUS_OK, b"reply-bytes")
+        assert frame.recv_reply(a) == (frame.STATUS_OK, b"reply-bytes")
+        # empty src and empty payload are legal frames
+        frame.send_request(a, frame.KIND_PING, b"", b"")
+        assert frame.recv_request(b) == (frame.KIND_PING, b"", b"")
+    finally:
+        a.close()
+        b.close()
+
+
+def _expect_frame_error(raw, recv_fn, **kw):
+    """Feed raw bytes to a receiver on a fresh pair (a FrameError can
+    fire before the body is drained, so cases never share a stream)."""
+    a, b = _pair()
+    try:
+        a.sendall(raw)
+        with pytest.raises(FrameError):
+            recv_fn(b, **kw)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_rejects_oversized_and_garbage_lengths():
+    # a garbage length prefix must raise BEFORE any allocation
+    _expect_frame_error(
+        struct.pack("<I", frame.MAX_FRAME_BYTES + 1), frame.recv_request,
+    )
+    # a request frame too short to hold its own header
+    _expect_frame_error(struct.pack("<I", 1) + b"\x00", frame.recv_request)
+    # src length overrunning the frame body
+    body = frame._REQ_HEAD.pack(frame.KIND_SYNC, 500) + b"short"
+    _expect_frame_error(
+        struct.pack("<I", len(body)) + body, frame.recv_request,
+    )
+    # zero-length reply frame cannot hold a status byte
+    _expect_frame_error(struct.pack("<I", 0), frame.recv_reply)
+    # per-call max_frame tightens the ceiling below the default
+    a, b = _pair()
+    try:
+        frame.send_request(a, frame.KIND_SYNC, b"", b"x" * 100)
+        with pytest.raises(FrameError):
+            frame.recv_request(b, max_frame=50)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_eof_mid_frame_is_connection_error():
+    a, b = _pair()
+    a.sendall(struct.pack("<I", 10) + b"abc")   # promises 10, sends 3
+    a.close()
+    try:
+        with pytest.raises(ConnectionError):
+            frame.recv_request(b)
+    finally:
+        b.close()
+
+
+def test_allocate_ports_distinct_and_bindable():
+    ports = allocate_ports(8)
+    assert len(set(ports)) == 8
+    for p in ports:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", p))
+        s.close()
+
+
+# ------------------------------------------------------- own-event WAL
+
+
+def _own_events(pk, sk, n, tag=b"w"):
+    return [
+        Event(
+            d=tag + b"-%d" % i,
+            p=(crypto.hash_bytes(tag + b"p%d" % i),
+               crypto.hash_bytes(tag + b"q%d" % i)),
+            t=i, c=pk,
+        ).signed(sk)
+        for i in range(n)
+    ]
+
+
+def test_wal_roundtrip_and_clean_marker_semantics(tmp_path):
+    pk, sk = crypto.keypair(b"wal-owner")
+    path = str(tmp_path / "own.wal")
+    w = OwnEventWal(path, pk=pk)
+    assert not w.existed and not w.unclean
+    evs = _own_events(pk, sk, 3)
+    for ev in evs:
+        w.append(ev)
+    w.mark_clean()
+    # reopen: clean shutdown observed, events intact, marker consumed
+    w2 = OwnEventWal(path, pk=pk)
+    assert w2.existed and w2.clean_shutdown and not w2.unclean
+    assert [e.id for e in w2.events] == [e.id for e in evs]
+    assert w2.torn_tail_recovered == 0
+    w2.close()
+    # the reopen truncated the marker away: a third open without a new
+    # mark_clean sees an unclean shutdown — "clean" only ever holds
+    # between a graceful stop and the next start
+    w3 = OwnEventWal(path, pk=pk)
+    assert w3.unclean and not w3.clean_shutdown
+    assert [e.id for e in w3.events] == [e.id for e in evs]
+    w3.close()
+
+
+def test_wal_torn_tail_truncation_at_every_offset(tmp_path):
+    """kill -9 tears the last append at an arbitrary byte: recovery must
+    keep exactly the durable prefix at EVERY possible cut point, count
+    the torn tail, and let appending resume from the cut."""
+    pk, sk = crypto.keypair(b"wal-torn")
+    path = str(tmp_path / "torn.wal")
+    w = OwnEventWal(path, pk=pk)
+    evs = _own_events(pk, sk, 3)
+    for ev in evs:
+        w.append(ev)
+    w.close()
+    with open(path, "rb") as f:
+        data = f.read()
+    last_rec = bytes([TAG_EVENT]) + encode_event(evs[-1])
+    last_start = len(data) - len(last_rec)
+    assert data[last_start:] == last_rec
+    prefix_ids = [e.id for e in evs[:-1]]
+    for cut in range(last_start, len(data)):
+        torn_path = str(tmp_path / ("cut-%d.wal" % cut))
+        with open(torn_path, "wb") as f:
+            f.write(data[:cut])
+        t = OwnEventWal(torn_path, pk=pk)
+        assert [e.id for e in t.events] == prefix_ids, cut
+        # a cut exactly on the record boundary is a whole-record loss,
+        # not torn bytes; every other offset is a detected torn tail
+        assert t.torn_tail_recovered == (0 if cut == last_start else 1), cut
+        assert t.unclean
+        # appending resumes cleanly from the truncated prefix
+        extra = _own_events(pk, sk, 1, tag=b"extra")[0]
+        t.append(extra)
+        t.close()
+        t2 = OwnEventWal(torn_path, pk=pk)
+        assert [e.id for e in t2.events] == prefix_ids + [extra.id], cut
+        assert t2.torn_tail_recovered == 0
+        t2.close()
+
+
+def test_wal_corrupt_tail_and_foreign_creator_recovered(tmp_path):
+    pk, sk = crypto.keypair(b"wal-corrupt")
+    path = str(tmp_path / "c.wal")
+    w = OwnEventWal(path, pk=pk)
+    evs = _own_events(pk, sk, 2)
+    for ev in evs:
+        w.append(ev)
+    w.close()
+    with open(path, "rb") as f:
+        data = f.read()
+    # bit-rot inside the last record body: decodes-but-unverifiable (or
+    # undecodable) — either way the valid prefix is events[:-1]
+    last_rec = bytes([TAG_EVENT]) + encode_event(evs[-1])
+    flip_at = len(data) - len(last_rec) // 2
+    flipped = bytearray(data)
+    flipped[flip_at] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(flipped))
+    t = OwnEventWal(path, pk=pk)
+    assert [e.id for e in t.events] == [evs[0].id]
+    assert t.torn_tail_recovered == 1
+    t.close()
+    # a record naming a foreign creator can only mean corruption: an own-
+    # event WAL never holds another member's history
+    other_pk, other_sk = crypto.keypair(b"other-member")
+    foreign = _own_events(other_pk, other_sk, 1, tag=b"f")[0]
+    path2 = str(tmp_path / "f.wal")
+    w2 = OwnEventWal(path2, pk=pk)
+    w2.append(evs[0])
+    w2.close()
+    with open(path2, "ab") as f:
+        f.write(bytes([TAG_EVENT]) + encode_event(foreign))
+    t2 = OwnEventWal(path2, pk=pk)
+    assert [e.id for e in t2.events] == [evs[0].id]
+    assert t2.torn_tail_recovered == 1
+    t2.close()
+    # a whole-file mangle (bad magic) recovers to an empty WAL
+    path3 = str(tmp_path / "m.wal")
+    with open(path3, "wb") as f:
+        f.write(b"NOTAWAL" + b"\x00" * 40)
+    t3 = OwnEventWal(path3, pk=pk)
+    assert t3.events == [] and t3.torn_tail_recovered == 1
+    t3.append(evs[0])
+    t3.close()
+    t4 = OwnEventWal(path3, pk=pk)
+    assert [e.id for e in t4.events] == [evs[0].id]
+    t4.close()
+
+
+def test_wal_clean_marker_mid_file_is_torn_state(tmp_path):
+    pk, sk = crypto.keypair(b"wal-mid")
+    path = str(tmp_path / "mid.wal")
+    w = OwnEventWal(path, pk=pk)
+    evs = _own_events(pk, sk, 2)
+    w.append(evs[0])
+    w.mark_clean()
+    # bytes after a "clean" marker mean the file kept growing after a
+    # supposedly-final close: torn state, recover the prefix
+    with open(path, "ab") as f:
+        f.write(bytes([TAG_EVENT]) + encode_event(evs[1]))
+    t = OwnEventWal(path, pk=pk)
+    assert [e.id for e in t.events] == [evs[0].id]
+    assert t.torn_tail_recovered == 1 and not t.clean_shutdown
+    t.close()
+
+
+def test_wal_rewrite_prunes_atomically(tmp_path):
+    pk, sk = crypto.keypair(b"wal-prune")
+    path = str(tmp_path / "p.wal")
+    w = OwnEventWal(path, pk=pk)
+    evs = _own_events(pk, sk, 4)
+    for ev in evs:
+        w.append(ev)
+    w.rewrite(evs[2:])          # checkpoint covered the first two
+    assert [e.id for e in w.events] == [e.id for e in evs[2:]]
+    tail = _own_events(pk, sk, 1, tag=b"t")[0]
+    w.append(tail)              # appending still works post-rewrite
+    w.close()
+    t = OwnEventWal(path, pk=pk)
+    assert [e.id for e in t.events] == [evs[2].id, evs[3].id, tail.id]
+    assert t.torn_tail_recovered == 0
+    t.close()
+    assert not os.path.exists(path + ".tmp")
+
+
+# ------------------------------------------------------- tx ingestion
+
+
+def test_txpool_ack_dup_and_batch_roundtrip():
+    pool = TxPool(max_pool=100, batch_bytes=1 << 16, max_tx_bytes=1024)
+    ok, reply = pool.submit(b"hello")
+    txid = crypto.hash_bytes(b"hello")
+    assert ok and reply == b"ACK:" + txid.hex().encode()
+    ok2, reply2 = pool.submit(b"hello")
+    assert not ok2 and reply2 == b"DUP:" + txid.hex().encode()
+    pool.submit(b"world")
+    batch = pool.next_batch()
+    assert decode_batch(batch) == [b"hello", b"world"]
+    assert pool.next_batch() == b""        # drained
+    # batched txs stay deduplicated after the drain
+    ok3, reply3 = pool.submit(b"hello")
+    assert not ok3 and reply3.startswith(b"DUP:")
+    c = pool.counters
+    assert c["tx_submitted"] == 4 and c["tx_accepted"] == 2
+    assert c["tx_duplicate"] == 2 and c["tx_batched"] == 2
+
+
+def test_txpool_shed_oversize_pool_and_window():
+    window = [0]
+    pool = TxPool(
+        max_pool=2, batch_bytes=1 << 16, max_tx_bytes=8,
+        max_undecided=10, window_fn=lambda: window[0],
+    )
+    assert pool.submit(b"x" * 9) == (False, b"SHED:oversize")
+    assert pool.submit(b"") == (False, b"SHED:oversize")
+    window[0] = 11                          # behind on consensus: shed
+    assert pool.submit(b"a") == (False, b"SHED:window")
+    window[0] = 10                          # at the threshold: admit
+    assert pool.submit(b"a")[0]
+    assert pool.submit(b"b")[0]
+    assert pool.submit(b"c") == (False, b"SHED:pool")
+    c = pool.counters
+    assert c["tx_shed_oversize"] == 2
+    assert c["tx_shed_window"] == 1
+    assert c["tx_shed_pool"] == 1
+    assert len(pool.pending) == 2
+
+
+def test_txpool_batch_size_cap_and_fifo_order():
+    pool = TxPool(max_pool=100, batch_bytes=64, max_tx_bytes=1024)
+    txs = [b"tx-%02d" % i + b"y" * 10 for i in range(10)]
+    for tx in txs:
+        assert pool.submit(tx)[0]
+    drained = []
+    while pool.pending:
+        batch = pool.next_batch()
+        assert len(batch) <= 64
+        drained.extend(decode_batch(batch))
+    assert drained == txs                   # FIFO across batches
+    assert pool.counters["tx_batches"] >= 2
+
+
+def test_txpool_oversized_single_tx_still_ships():
+    """One tx bigger than batch_bytes must still drain (a batch always
+    ships >= 1 tx) — otherwise it wedges the FIFO head forever."""
+    pool = TxPool(max_pool=10, batch_bytes=16, max_tx_bytes=1024)
+    big = b"B" * 100
+    assert pool.submit(big)[0]
+    assert decode_batch(pool.next_batch()) == [big]
+
+
+def test_decode_batch_total_on_garbage():
+    assert decode_batch(b"") == []
+    assert decode_batch(b"tx:0:1") == []           # legacy sim payload
+    assert decode_batch(b"TXB1") == []             # truncated header
+    assert decode_batch(b"TXB1\x02\x00\x04\x00\x00\x00abcd") == []
+    good = encode_batch([b"a", b"bb"])
+    assert decode_batch(good) == [b"a", b"bb"]
+    assert decode_batch(good[:-1]) == []           # torn tail
+    assert decode_batch(encode_batch([])) == []
+
+
+# ----------------------------------------- SW003 justified suppression
+
+
+_CLOCK_SRC = "import time\n\ndef f():\n    return time.monotonic(){}\n"
+
+
+def _sw003(module_path, suffix, prefix=""):
+    return check_source(
+        prefix + _CLOCK_SRC.format(suffix),
+        module_path=module_path, rules=["SW003"],
+    )
+
+
+def test_sw003_net_scope_requires_justified_suppression():
+    # net/ is in scope: an unsuppressed wall-clock read is a finding
+    assert len(_sw003("net/x.py", "")) == 1
+    # a bare line disable no longer suppresses inside net/
+    assert len(_sw003("net/x.py", "   # swirld-lint: disable=SW003")) == 1
+    # a justified suppression (``-- why``) does
+    assert _sw003(
+        "net/x.py",
+        "   # swirld-lint: disable=SW003 -- deployment-edge deadline",
+    ) == []
+    # a note for a DIFFERENT rule id does not cover SW003
+    assert len(_sw003(
+        "net/x.py", "   # swirld-lint: disable=SW001 -- wrong rule",
+    )) == 1
+    # disable-file never counts in the note scope: the wall-clock
+    # surface must stay enumerable line by line
+    assert len(_sw003(
+        "net/x.py", "", prefix="# swirld-lint: disable-file=SW003\n",
+    )) == 1
+
+
+def test_sw003_note_scope_is_pinned_to_net():
+    # outside the rule's scope entirely: no finding to suppress
+    assert _sw003("sim.py", "") == []
+    # in scope but outside note_scope: the old bare-disable semantics
+    # still hold (no churn on existing suppressions)
+    assert len(_sw003("transport.py", "")) == 1
+    assert _sw003("transport.py", "   # swirld-lint: disable=SW003") == []
+
+
+def test_net_package_wall_clock_surface_is_exactly_frame():
+    """The shipped net/ package passes its own gate: every wall-clock
+    read lives in frame.py behind a justified suppression."""
+    import tpu_swirld.net as netpkg
+    from tpu_swirld.analysis.lint import lint_paths
+
+    findings = lint_paths(
+        [os.path.dirname(netpkg.__file__)], rules=["SW003"],
+    )
+    assert findings == [], [str(f) for f in findings]
+
+
+# -------------------------------------------------- socket transport
+
+
+def _serve_node(node, port):
+    def dispatch(kind, src, payload):
+        if kind == frame.KIND_SYNC:
+            return frame.STATUS_OK, node.ask_sync(src, payload)
+        if kind == frame.KIND_WANT:
+            return frame.STATUS_OK, node.ask_events(src, payload)
+        raise ValueError("unknown kind %d" % kind)
+
+    return NodeServer("127.0.0.1", port, dispatch, frame.MAX_FRAME_BYTES)
+
+
+def test_socket_transport_parity_with_in_process_transport():
+    """Same members, same seed, same schedule — the in-process Transport
+    and a loopback SocketTransport must decide bit-identical prefixes
+    (the wire is a delivery detail, never a consensus input)."""
+    n, turns, seed = 3, 60, 11
+    config = SwirldConfig(n_members=n, seed=seed)
+    keys = [crypto.keypair(b"parity-%d" % i) for i in range(n)]
+    members = [pk for pk, _ in keys]
+
+    # reference: the in-process dict-of-endpoints transport
+    clock = [0]
+    network, network_want = {}, {}
+    ref_transport = Transport(network, network_want)
+    ref_nodes = []
+    for pk, sk in keys:
+        node = Node(
+            sk=sk, pk=pk, network=network, members=members, config=config,
+            clock=lambda: clock[0], network_want=network_want,
+            transport=ref_transport,
+        )
+        network[pk] = node.ask_sync
+        network_want[pk] = node.ask_events
+        ref_nodes.append(node)
+
+    # candidate: the same nodes behind loopback TCP
+    ports = allocate_ports(n)
+    clock2 = [0]
+    settings = resolve_net_settings()
+    sock_nodes, servers, transports = [], [], []
+    try:
+        for i, (pk, sk) in enumerate(keys):
+            st = SocketTransport(settings=settings, src=pk)
+            for j, pk_j in enumerate(members):
+                if j != i:
+                    st.register(pk_j, "127.0.0.1", ports[j])
+            node = Node(
+                sk=sk, pk=pk, network={}, members=members, config=config,
+                clock=lambda: clock2[0], transport=st,
+            )
+            transports.append(st)
+            sock_nodes.append(node)
+        for i, node in enumerate(sock_nodes):
+            servers.append(_serve_node(node, ports[i]))
+
+        # one seeded schedule, two delivery layers: identical draws
+        rng_a, rng_b = random.Random(seed), random.Random(seed)
+        for t in range(turns):
+            clock[0] = t + 1
+            i = rng_a.randrange(n)
+            node = ref_nodes[i]
+            peer = rng_a.choice([m for m in members if m != node.pk])
+            new = node.sync(peer, b"tx:%d" % t)
+            if new:
+                node.consensus_pass(new)
+        for t in range(turns):
+            clock2[0] = t + 1
+            i = rng_b.randrange(n)
+            node = sock_nodes[i]
+            peer = rng_b.choice([m for m in members if m != node.pk])
+            new = node.sync(peer, b"tx:%d" % t)
+            if new:
+                node.consensus_pass(new)
+    finally:
+        for s in servers:
+            s.close()
+        for st in transports:
+            st.close()
+
+    ref_orders = [list(nd.consensus) for nd in ref_nodes]
+    sock_orders = [list(nd.consensus) for nd in sock_nodes]
+    assert min(len(o) for o in ref_orders) > 0
+    assert sock_orders == ref_orders
+    # the decided EVENTS (not just ids) are bit-identical too
+    for ref, cand in zip(ref_nodes, sock_nodes):
+        for eid in ref.consensus:
+            assert encode_event(ref.hg[eid]) == encode_event(cand.hg[eid])
+    # real traffic flowed over the wire
+    assert all(st.stats["calls"] > 0 for st in transports)
+
+
+def test_socket_transport_error_plane_mapping():
+    pk_self, _ = crypto.keypair(b"err-self")
+    pk_peer, _ = crypto.keypair(b"err-peer")
+    settings = resolve_net_settings()
+    settings["connect_timeout_s"] = 0.5
+    settings["call_timeout_s"] = 0.3
+
+    # no address registered at all
+    st = SocketTransport(settings=settings, src=pk_self)
+    with pytest.raises(PeerUnreachable):
+        st.call(pk_self, pk_peer, CHANNEL_SYNC, b"x")
+    assert st.endpoint(pk_peer, CHANNEL_SYNC) is None
+
+    # nothing listening on the port: connect refused -> PeerUnreachable
+    (port,) = allocate_ports(1)
+    st.register(pk_peer, "127.0.0.1", port)
+    assert st.endpoint(pk_peer, CHANNEL_SYNC) == ("127.0.0.1", port)
+    with pytest.raises(PeerUnreachable):
+        st.call(pk_self, pk_peer, CHANNEL_SYNC, b"x")
+    assert st.stats["connect_failures"] >= 1
+
+    # a listener that never replies: deadline -> DeliveryTimeout
+    silent = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    silent.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    silent.bind(("127.0.0.1", 0))
+    silent.listen(1)
+    st.register(pk_peer, "127.0.0.1", silent.getsockname()[1])
+    try:
+        with pytest.raises(DeliveryTimeout):
+            st.call(pk_self, pk_peer, CHANNEL_SYNC, b"x")
+        assert st.stats["timeouts"] == 1
+    finally:
+        silent.close()
+        st.close()
+
+
+def test_socket_transport_status_reject_and_error_planes():
+    """STATUS_REJECT resurfaces as the endpoints' documented ValueError
+    (counted bad reply, never retried); STATUS_ERROR is retryable."""
+    (port,) = allocate_ports(1)
+    mode = {"raise": ValueError("bad request payload")}
+
+    def dispatch(kind, src, payload):
+        raise mode["raise"]
+
+    server = NodeServer("127.0.0.1", port, dispatch, frame.MAX_FRAME_BYTES)
+    pk_self, _ = crypto.keypair(b"rej-self")
+    pk_peer, _ = crypto.keypair(b"rej-peer")
+    st = SocketTransport(settings=resolve_net_settings(), src=pk_self)
+    st.register(pk_peer, "127.0.0.1", port)
+    try:
+        with pytest.raises(ValueError, match="bad request payload"):
+            st.call(pk_self, pk_peer, CHANNEL_SYNC, b"x")
+        assert st.stats["rejects"] == 1
+        mode["raise"] = RuntimeError("server bug")
+        with pytest.raises(PeerUnreachable, match="server error"):
+            st.call(pk_self, pk_peer, CHANNEL_SYNC, b"x")
+        assert st.stats["peer_errors"] == 1
+    finally:
+        server.close()
+        st.close()
+
+
+def test_socket_transport_redials_stale_cached_connection():
+    """A cached connection killed server-side is redialed once,
+    transparently — a restarted peer costs one redial, not a failure."""
+    (port,) = allocate_ports(1)
+
+    # a one-shot first incarnation: serves one request, closes the conn
+    # AND the listener (so the "restarted" server can re-bind the port)
+    ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    ls.bind(("127.0.0.1", port))
+    ls.listen(1)
+
+    def one_shot():
+        conn, _addr = ls.accept()
+        _kind, _src, payload = frame.recv_request(conn)
+        frame.send_reply(conn, frame.STATUS_OK, b"pong:" + payload)
+        conn.close()
+        ls.close()
+
+    t = threading.Thread(target=one_shot, daemon=True)
+    t.start()
+
+    pk_self, _ = crypto.keypair(b"redial-self")
+    pk_peer, _ = crypto.keypair(b"redial-peer")
+    st = SocketTransport(settings=resolve_net_settings(), src=pk_self)
+    st.register(pk_peer, "127.0.0.1", port)
+    server = None
+    try:
+        assert st.call(pk_self, pk_peer, CHANNEL_SYNC, b"a") == b"pong:a"
+        t.join(5)
+        assert not t.is_alive()
+
+        def dispatch(kind, src, payload):
+            return frame.STATUS_OK, b"pong:" + payload
+
+        server = NodeServer(
+            "127.0.0.1", port, dispatch, frame.MAX_FRAME_BYTES,
+        )
+        # the cached conn is dead; the call must redial, not fail
+        assert st.call(pk_self, pk_peer, CHANNEL_SYNC, b"b") == b"pong:b"
+    finally:
+        if server is not None:
+            server.close()
+        st.close()
+        ls.close()
+
+
+# ------------------------------------------------ startup post-mortem
+
+
+def test_startup_postmortem_dumps_only_on_unclean_wal(tmp_path):
+    pk, sk = crypto.keypair(b"pm-owner")
+    path = str(tmp_path / "pm.wal")
+    w = OwnEventWal(path, pk=pk)
+    for ev in _own_events(pk, sk, 2):
+        w.append(ev)
+    w.mark_clean()
+    dump_dir = str(tmp_path / "dumps")
+    os.makedirs(dump_dir)
+    # clean shutdown: no dump
+    clean = OwnEventWal(path, pk=pk)
+    rec = FlightRecorder(dump_dir=dump_dir, wall_clock=lambda: 0.0)
+    assert startup_postmortem(clean, rec, "n0") is None
+    clean.close()
+    # that reopen consumed the marker; the next open is unclean — the
+    # previous incarnation "died" without a graceful stop
+    unclean = OwnEventWal(path, pk=pk)
+    assert unclean.unclean
+    dump = startup_postmortem(unclean, rec, "n0")
+    assert dump is not None and os.path.exists(dump)
+    doc = load_dump(dump)
+    assert doc["reason"] == "unclean_shutdown"
+    assert rec.trigger_counts["unclean_shutdown"] == 1
+    unclean.close()
+    # no dump dir: the trigger is recorded but returns no path
+    rec2 = FlightRecorder(dump_dir=None)
+    unclean2 = OwnEventWal(path, pk=pk)
+    assert startup_postmortem(unclean2, rec2, "n1") is None
+    assert rec2.trigger_counts["unclean_shutdown"] == 1
+    unclean2.close()
+
+
+def test_node_server_worker_threads_keep_no_state():
+    """SW006 surface check: NodeServer's worker threads must not store
+    mutable state on self — everything flows through the dispatch
+    closure (the lock discipline the analysis suite audits)."""
+    (port,) = allocate_ports(1)
+    seen = []
+    done = threading.Event()
+
+    def dispatch(kind, src, payload):
+        seen.append((kind, src, payload))
+        done.set()
+        return frame.STATUS_OK, b"ok"
+
+    server = NodeServer("127.0.0.1", port, dispatch, frame.MAX_FRAME_BYTES)
+    try:
+        with socket.create_connection(("127.0.0.1", port), timeout=5) as s:
+            s.settimeout(5.0)
+            frame.send_request(s, frame.KIND_PING, b"me", b"probe")
+            assert frame.recv_reply(s) == (frame.STATUS_OK, b"ok")
+        assert done.wait(5)
+        assert seen == [(frame.KIND_PING, b"me", b"probe")]
+    finally:
+        server.close()

@@ -210,7 +210,21 @@ def suppression_notes(source: str) -> Dict[int, Tuple[set, str]]:
     return out
 
 
-def _suppressed(f: Finding, per_line: Dict[int, set], per_file: set) -> bool:
+def _suppressed(
+    f: Finding,
+    per_line: Dict[int, set],
+    per_file: set,
+    notes: Optional[Dict[int, Tuple[set, str]]] = None,
+    require_note: bool = False,
+) -> bool:
+    if require_note:
+        # justified-suppression scope (Rule.note_scope): only a line
+        # suppression carrying a non-empty ``-- why`` note counts; bare
+        # disables and file-wide disables stay findings
+        ids, note = (notes or {}).get(f.line, (set(), ""))
+        return bool(
+            note and (f.rule in ids or f.name in ids or "all" in ids)
+        )
     for ids in (per_file, per_line.get(f.line, ())):
         if ids and (f.rule in ids or f.name in ids or "all" in ids):
             return True
@@ -290,11 +304,14 @@ def lint_paths(
     for path, source, tree in parsed:
         ctx = FileContext(path, source, module_path(path), index)
         per_line, per_file = _suppressions(source)
+        notes = suppression_notes(source)
         for rule in rule_objs:
             if not rule.applies(ctx.module_path):
                 continue
+            require_note = rule.requires_note(ctx.module_path)
             for f in rule.check(ctx):
-                if not _suppressed(f, per_line, per_file):
+                if not _suppressed(f, per_line, per_file, notes=notes,
+                                   require_note=require_note):
                     findings.append(f)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
@@ -317,12 +334,15 @@ def check_source(
         index.scan(ast.parse(source))
     ctx = FileContext(path, source, module_path, index)
     per_line, per_file = _suppressions(source)
+    notes = suppression_notes(source)
     out = []
     for rule in _load_rules(rules):
         if not rule.applies(ctx.module_path):
             continue
+        require_note = rule.requires_note(ctx.module_path)
         for f in rule.check(ctx):
-            if not _suppressed(f, per_line, per_file):
+            if not _suppressed(f, per_line, per_file, notes=notes,
+                               require_note=require_note):
                 out.append(f)
     out.sort(key=lambda f: (f.line, f.col, f.rule))
     return out

@@ -14,7 +14,12 @@ Catalog:
   consensus-critical modules (``oracle/``, ``store/streaming.py``,
   ``tpu/pipeline.py``, ``chaos.py``) without an explicit ``sorted()``.
 - **SW003 wall-clock** — no ``time.time`` / ``time.sleep`` /
-  ``datetime.now`` in the logical-time transport/retry layer.
+  ``datetime.now`` in the logical-time transport/retry layer.  Inside
+  ``net/`` (the socket deployment edge, which legitimately needs real
+  deadlines) the rule still applies but accepts *justified* line
+  suppressions only — ``disable=SW003 -- <why>`` with a non-empty note,
+  mirroring the SW008 flow-audit semantics; bare disables and
+  ``disable-file`` do not count.
 - **SW004 dtype-discipline** — kernel/slab allocations (``tpu/``,
   ``store/``, ``parallel.py``) must pin an explicit dtype; NumPy's
   implicit int64/float64 promotion and builtin-``int`` dtypes are
@@ -65,6 +70,11 @@ class Rule:
     describe: str = ""
     #: module-path prefixes this rule applies to; empty = every module
     scope: Tuple[str, ...] = ()
+    #: module-path prefixes where only a *justified* line suppression
+    #: (``# swirld-lint: disable=<id> -- <why>``) silences a finding —
+    #: bare disables and ``disable-file`` do not (the SW008 flow-audit
+    #: semantics, opt-in per rule/scope)
+    note_scope: Tuple[str, ...] = ()
 
     def applies(self, module_path: str) -> bool:
         if not self.scope:
@@ -72,6 +82,12 @@ class Rule:
         return any(
             module_path == s or module_path.startswith(s)
             for s in self.scope
+        )
+
+    def requires_note(self, module_path: str) -> bool:
+        return any(
+            module_path == s or module_path.startswith(s)
+            for s in self.note_scope
         )
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:

@@ -57,6 +57,77 @@ from tpu_swirld.sim import DivergentForker, attach_obs, build_population
 from tpu_swirld.transport import FaultPlan, FaultyTransport, Partition
 
 
+def oracle_replay(
+    union: Dict[bytes, "object"],
+    members: List[bytes],
+    config: SwirldConfig,
+    observer_key,
+) -> List[bytes]:
+    """Fault-free ground truth for a union event store: a fresh observer
+    ingests ``union`` (id -> Event) in deterministic topo order and
+    recomputes consensus from scratch.  Consensus is a pure function of
+    the DAG, so this is the order every honest participant must have
+    decided a prefix of — shared by the in-process chaos verdict and the
+    real-process cluster verdict (:mod:`tpu_swirld.net.cluster`), which
+    rebuilds ``union`` from the per-process event logs."""
+    ordered = toposort(
+        sorted(union, key=lambda e: (union[e].t, e)),
+        lambda e: [p for p in union[e].p],
+    )
+    pk, sk = observer_key
+    observer = Node(
+        sk=sk, pk=pk, network={}, members=members,
+        config=config, create_genesis=False,
+    )
+    new_ids = []
+    for eid in ordered:
+        if observer.add_event(union[eid]):
+            new_ids.append(eid)
+    observer.consensus_pass(new_ids)
+    return observer.consensus
+
+
+def safety_section(
+    orders: List[List[bytes]], oracle: List[bytes],
+) -> Dict:
+    """The verdict's safety block: all honest decided orders agree on
+    their common prefix AND each is bit-identical to a prefix of the
+    fault-free oracle replay."""
+    m = min(len(o) for o in orders) if orders else 0
+    return {
+        "prefix_agree": all(o[:m] == orders[0][:m] for o in orders),
+        "oracle_agree": all(o == oracle[:len(o)] for o in orders),
+        "common_prefix_len": m,
+        "oracle_len": len(oracle),
+    }
+
+
+def liveness_section(
+    decided_final: int,
+    decided_at_heal: Optional[int],
+    heal_turn,
+) -> Dict:
+    """The verdict's liveness block: the decided frontier advanced past
+    the last fault window (``heal_turn == 0`` means a fault-free run —
+    any progress counts)."""
+    heal_base = decided_at_heal if decided_at_heal is not None else 0
+    return {
+        "decided_at_heal": heal_base,
+        "decided_final": decided_final,
+        "advanced_after_heal": decided_final > heal_base or heal_turn == 0,
+        "heal_turn": heal_turn,
+    }
+
+
+def verdict_ok(safety: Dict, liveness: Dict) -> bool:
+    """The one-bit summary both harnesses gate on."""
+    return bool(
+        safety["prefix_agree"] and safety["oracle_agree"]
+        and liveness["decided_final"] > 0
+        and liveness["advanced_after_heal"]
+    )
+
+
 @dataclasses.dataclass
 class ChaosScenario:
     """One seeded chaos run: population shape + fault schedule.
@@ -377,36 +448,15 @@ class ChaosSimulation:
         union = {}
         for n in self._live_honest():
             union.update(n.hg)
-        ordered = toposort(
-            sorted(union, key=lambda e: (union[e].t, e)),
-            lambda e: [p for p in union[e].p],
-        )
-        pk, sk = self.keys[-1]
-        observer = Node(
-            sk=sk, pk=pk, network={}, members=self.members,
-            config=self.config, create_genesis=False,
-        )
-        new_ids = []
-        for eid in ordered:
-            if observer.add_event(union[eid]):
-                new_ids.append(eid)
-        observer.consensus_pass(new_ids)
-        return observer.consensus
+        return oracle_replay(union, self.members, self.config, self.keys[-1])
 
     def verdict(self) -> Dict:
         nodes = self._live_honest()
         orders = [n.consensus for n in nodes]
-        m = min(len(o) for o in orders) if orders else 0
-        prefix_agree = all(o[:m] == orders[0][:m] for o in orders)
-        oracle = self.oracle_order()
-        oracle_agree = all(
-            o == oracle[: len(o)] for o in orders
+        safety = safety_section(orders, self.oracle_order())
+        liveness = liveness_section(
+            self._min_decided(), self._decided_at_heal, self._heal_t,
         )
-        decided_final = self._min_decided()
-        heal_base = (
-            self._decided_at_heal if self._decided_at_heal is not None else 0
-        )
-        live_after_heal = decided_final > heal_base or self._heal_t == 0
         quarantined = sorted(
             {
                 self.transport.member_index.get(p, -1)
@@ -414,24 +464,10 @@ class ChaosSimulation:
                 for p in n.breaker.quarantined()
             }
         )
-        ok = bool(
-            prefix_agree and oracle_agree and decided_final > 0
-            and live_after_heal
-        )
         return {
-            "ok": ok,
-            "safety": {
-                "prefix_agree": prefix_agree,
-                "oracle_agree": oracle_agree,
-                "common_prefix_len": m,
-                "oracle_len": len(oracle),
-            },
-            "liveness": {
-                "decided_at_heal": heal_base,
-                "decided_final": decided_final,
-                "advanced_after_heal": live_after_heal,
-                "heal_turn": self._heal_t,
-            },
+            "ok": verdict_ok(safety, liveness),
+            "safety": safety,
+            "liveness": liveness,
             "faults": dict(self.transport.stats),
             "resilience": {
                 "crashes": self.crashes,

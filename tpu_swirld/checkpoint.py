@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import struct
 from typing import Callable, Dict, List, Optional
 
@@ -138,8 +139,15 @@ def save_node(path: str, node: Node) -> None:
         "order_digest": crypto.hash_bytes(b"".join(node.consensus)).hex(),
     }
     header = json.dumps(meta).encode()
-    with open(path, "wb") as f:
+    # atomic replace: a process killed (kill -9) mid-checkpoint must
+    # leave either the previous checkpoint or the new one intact — a
+    # torn half-file would fail the restart that most needs it
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
         f.write(b"SWCK" + struct.pack("<I", len(header)) + header + log)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 def load_node(

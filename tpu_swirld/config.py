@@ -11,8 +11,9 @@ variables so a deployment can retune the background spill pipeline
 without touching code: an explicit ``SwirldConfig`` field wins, then the
 environment variable, then the built-in default (see
 :func:`resolve_archive_settings`).  The flight-recorder knobs
-(``SWIRLD_FLIGHTREC_*``, :func:`resolve_flightrec_settings`) follow the
-same precedence.
+(``SWIRLD_FLIGHTREC_*``, :func:`resolve_flightrec_settings`) and the
+socket/cluster knobs (``SWIRLD_NET_*``, :func:`resolve_net_settings`)
+follow the same precedence.
 """
 
 from __future__ import annotations
@@ -61,6 +62,46 @@ def resolve_flightrec_settings(
             raw = os.environ.get(env)
             v = parse(raw) if raw is not None else default
         out[names[field]] = v
+    return out
+
+
+#: built-in socket/cluster defaults (field -> (env var, default, parser)).
+#: Same precedence as the archive/flightrec knobs: explicit SwirldConfig
+#: field > SWIRLD_NET_* env var > built-in default.  Units are wall
+#: seconds (the net layer is the deployment edge; consensus stays
+#: logical-time) except the byte/count caps.
+_NET_ENV = {
+    "net_connect_timeout_s": ("SWIRLD_NET_CONNECT_TIMEOUT", 5.0, float),
+    "net_call_timeout_s": ("SWIRLD_NET_CALL_TIMEOUT", 10.0, float),
+    "net_max_frame_bytes": (
+        "SWIRLD_NET_MAX_FRAME", (1 << 24) + (1 << 16), int,
+    ),
+    "net_tx_batch_bytes": ("SWIRLD_NET_TX_BATCH_BYTES", 64 << 10, int),
+    "net_tx_max_bytes": ("SWIRLD_NET_TX_MAX_BYTES", 16 << 10, int),
+    "net_tx_pool_txs": ("SWIRLD_NET_TX_POOL", 4096, int),
+    "net_max_undecided": ("SWIRLD_NET_MAX_UNDECIDED", 2048, int),
+    "net_gossip_interval_s": ("SWIRLD_NET_GOSSIP_INTERVAL", 0.01, float),
+    "net_checkpoint_every_s": ("SWIRLD_NET_CHECKPOINT_EVERY", 1.0, float),
+    "net_retry_tick_s": ("SWIRLD_NET_RETRY_TICK", 0.02, float),
+}
+
+
+def resolve_net_settings(config: Optional["SwirldConfig"] = None) -> Dict:
+    """Concrete socket/cluster settings: explicit config field >
+    ``SWIRLD_NET_*`` env var > built-in default.  Returns
+    ``{"connect_timeout_s", "call_timeout_s", "max_frame_bytes",
+    "tx_batch_bytes", "tx_max_bytes", "tx_pool_txs", "max_undecided",
+    "gossip_interval_s", "checkpoint_every_s", "retry_tick_s"}``
+    (plain values, never ``None``).  ``retry_tick_s`` converts the
+    logical backoff ticks :class:`~tpu_swirld.transport.RetryPolicy`
+    computes into real sleep seconds for socket deployments."""
+    out = {}
+    for field, (env, default, parse) in _NET_ENV.items():
+        v = getattr(config, field, None) if config is not None else None
+        if v is None:
+            raw = os.environ.get(env)
+            v = parse(raw) if raw is not None else default
+        out[field[len("net_"):]] = v
     return out
 
 
@@ -172,6 +213,24 @@ class SwirldConfig:
                                                # stop writing (default 16)
     flightrec_dir: Optional[str] = None       # dump directory; None =
                                               # in-memory only, no files
+
+    # --- socket transport / real-process cluster (net/) ---
+    # None = fall back to SWIRLD_NET_* env var, then built-in default
+    # (resolve_net_settings).  Wall-second knobs live HERE, at the
+    # deployment edge; the consensus core stays logical-time.
+    net_connect_timeout_s: Optional[float] = None  # TCP connect deadline
+    net_call_timeout_s: Optional[float] = None     # per-RPC reply deadline
+    net_max_frame_bytes: Optional[int] = None      # frame ceiling (must
+                                                   # admit max_reply_bytes)
+    net_tx_batch_bytes: Optional[int] = None       # tx batch payload cap
+    net_tx_max_bytes: Optional[int] = None         # per-tx size cap
+    net_tx_pool_txs: Optional[int] = None          # pending-pool cap
+    net_max_undecided: Optional[int] = None        # undecided-window
+                                                   # admission threshold
+    net_gossip_interval_s: Optional[float] = None  # gossip loop pacing
+    net_checkpoint_every_s: Optional[float] = None  # checkpoint cadence
+    net_retry_tick_s: Optional[float] = None       # seconds per logical
+                                                   # RetryPolicy backoff tick
 
     def stakes(self) -> Tuple[int, ...]:
         if self.stake is not None:

@@ -115,6 +115,9 @@ def node_gauges(
         "decided_round_lag": max_round - getattr(node, "consensus_round", 0),
         "undecided_witnesses": undecided,
         "orphans_parked": getattr(node, "orphans_parked", 0),
+        # admission-control gauge: the tx ingestion layer sheds client
+        # submissions while this exceeds its configured threshold
+        "undecided_window": getattr(node, "undecided_window", 0),
         "late_witnesses": len(getattr(node, "late_witnesses", ())),
         "horizon_violations": getattr(node, "horizon_violations", 0),
         "forks_detected": getattr(node, "forks_detected", 0),

@@ -1,0 +1,40 @@
+"""Real-process cluster: sockets, tx ingestion, crash-recovery chaos.
+
+Everything before this package runs the protocol *in one process*: the
+sim's "network" is a dict of bound methods, the chaos harness injects
+faults by editing that dict, and kill -9 is simulated by dropping a node
+object.  This package is the deployment edge — the same
+:class:`~tpu_swirld.oracle.node.Node`, unchanged, over real TCP between
+real OS processes that really die:
+
+- :mod:`~tpu_swirld.net.frame` — length-prefixed framing, ephemeral-port
+  allocation, and the net layer's only wall-clock reads;
+- :mod:`~tpu_swirld.net.transport` — :class:`SocketTransport`, the
+  :class:`~tpu_swirld.transport.Transport` seam over per-peer TCP with
+  the in-process error planes preserved (certified bit-identical by the
+  parity suite);
+- :mod:`~tpu_swirld.net.ingest` — :class:`TxPool` client submission with
+  dedup, size caps, and undecided-window backpressure;
+- :mod:`~tpu_swirld.net.wal` — :class:`OwnEventWal`, the fsync'd
+  own-event log with torn-tail recovery and the clean-shutdown marker;
+- :mod:`~tpu_swirld.net.node_proc` — the per-process runtime (server +
+  gossip loop + checkpointing + startup post-mortem), run as
+  ``python -m tpu_swirld.net.node_proc spec.json``;
+- :mod:`~tpu_swirld.net.cluster` — the supervisor: launches N node
+  processes, drives client traffic, injects SIGKILL, restarts, and
+  renders the same safety/liveness verdict as :mod:`tpu_swirld.chaos`.
+"""
+
+from tpu_swirld.net.frame import allocate_ports
+from tpu_swirld.net.ingest import TxPool, decode_batch, encode_batch
+from tpu_swirld.net.transport import SocketTransport
+from tpu_swirld.net.wal import OwnEventWal
+
+__all__ = [
+    "OwnEventWal",
+    "SocketTransport",
+    "TxPool",
+    "allocate_ports",
+    "decode_batch",
+    "encode_batch",
+]

@@ -1,0 +1,501 @@
+"""One cluster node as an OS process: server, gossip loop, durability.
+
+``python -m tpu_swirld.net.node_proc spec.json`` runs a single member of
+a real-process cluster (:mod:`tpu_swirld.net.cluster` writes the spec
+and supervises N of these).  The runtime wires the unchanged
+:class:`~tpu_swirld.oracle.node.Node` to the real world:
+
+- a :class:`NodeServer` accepts framed TCP requests and dispatches them
+  — gossip (``ask_sync`` / ``ask_events``), client tx submission into a
+  :class:`~tpu_swirld.net.ingest.TxPool`, status probes, graceful stop;
+- a gossip loop picks seeded-random peers, drains tx batches into event
+  payloads via :class:`~tpu_swirld.net.transport.SocketTransport`, runs
+  consensus, and records each decided transaction into a
+  :class:`~tpu_swirld.obs.finality.FinalityTracker` (submission →
+  decided wall latency);
+- every own event is fsync'd into an :class:`~tpu_swirld.net.wal.
+  OwnEventWal` *before* it can be gossiped, and the node checkpoints
+  periodically (atomic :func:`~tpu_swirld.checkpoint.save_node`), so a
+  ``kill -9`` at any instant restarts into checkpoint + WAL replay +
+  pull-only recovery without ever equivocating against its own past;
+- a WAL that exists but lacks the clean-shutdown marker means the
+  previous incarnation died: :func:`startup_postmortem` dumps a flight-
+  recorder post-mortem before the node rejoins.
+
+Locking: ONE lock guards all node/pool/tracker state.  The gossip loop
+holds it for a whole turn, but :class:`_YieldingTransport` releases it
+around every blocking socket call (and the installed ``node._sleep``
+releases it around real backoff sleeps), so server threads serve
+incoming gossip while this node waits on the wire — two nodes syncing
+into each other cannot deadlock.
+
+The import chain stays jax-free (oracle node + checkpoint + obs), so a
+node process starts in milliseconds and never touches an accelerator.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import socket
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from tpu_swirld import crypto
+from tpu_swirld.checkpoint import load_node, save_node
+from tpu_swirld.config import SwirldConfig, resolve_net_settings
+from tpu_swirld.net import frame
+from tpu_swirld.net.ingest import TxPool, decode_batch
+from tpu_swirld.net.transport import SocketTransport
+from tpu_swirld.net.wal import OwnEventWal
+from tpu_swirld.obs.finality import FinalityTracker
+from tpu_swirld.obs.flightrec import FlightRecorder
+from tpu_swirld.oracle.event import encode_event
+from tpu_swirld.oracle.node import Node
+from tpu_swirld.sim import member_keys
+
+REPORT_VERSION = 1
+
+
+def derive_paths(workdir: str, index: int) -> Dict[str, str]:
+    """Per-node file layout inside the cluster workdir — shared
+    vocabulary between this runtime and the supervisor."""
+    stem = os.path.join(workdir, f"node-{index}")
+    return {
+        "ckpt": stem + ".swck",
+        "wal": stem + ".wal",
+        "report": stem + ".report.json",
+        "events": stem + ".events.bin",
+        "ready": stem + ".ready",
+    }
+
+
+def startup_postmortem(
+    wal: OwnEventWal, rec: FlightRecorder, label: str,
+) -> Optional[str]:
+    """Dump a post-mortem when the WAL shows an unclean shutdown.
+
+    The previous incarnation died without writing the clean marker — the
+    one moment a black box earns its keep.  Returns the dump path, or
+    ``None`` when the shutdown was clean (or no dump dir / budget).
+    """
+    if not wal.unclean:
+        return None
+    return rec.trigger(
+        "unclean_shutdown",
+        node=label,
+        detail={
+            "wal_path": wal.path,
+            "wal_events": len(wal.events),
+            "torn_tail_recovered": wal.torn_tail_recovered,
+        },
+    )
+
+
+class _YieldingTransport:
+    """Transport wrapper that releases the runtime lock around blocking
+    socket I/O.  The gossip loop owns the lock for a whole turn; without
+    this, a server thread handling a peer's sync would wait on the lock
+    while our own outbound call waits on that peer's equally-blocked
+    loop — a distributed deadlock.  ``call`` is only ever invoked with
+    the lock held (by the gossip loop's turn)."""
+
+    def __init__(self, inner: SocketTransport, lock: threading.Lock):
+        self.inner = inner
+        self.lock = lock
+
+    def endpoint(self, dst: bytes, channel: str):
+        return self.inner.endpoint(dst, channel)
+
+    def call(self, src: bytes, dst: bytes, channel: str, payload: bytes):
+        self.lock.release()
+        try:
+            return self.inner.call(src, dst, channel, payload)
+        finally:
+            self.lock.acquire()
+
+    def close(self) -> None:
+        self.inner.close()
+
+
+class NodeServer:
+    """Framed-TCP server: one accept loop, one daemon thread per
+    connection, every request answered through one ``dispatch``
+    callable.  All mutable runtime state lives behind the dispatch
+    closure's lock — worker threads store nothing on ``self``, so the
+    SW006 audit surface is empty by construction."""
+
+    def __init__(self, host: str, port: int, dispatch, max_frame: int):
+        self._dispatch = dispatch
+        self._max_frame = max_frame
+        self._stopping = threading.Event()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(32)
+        self._accept_thread = threading.Thread(
+            target=self._serve, daemon=True,
+        )
+        self._accept_thread.start()
+
+    def _serve(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                return   # listener closed: shutdown
+            threading.Thread(
+                target=self._handle, args=(conn,), daemon=True,
+            ).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            while True:
+                kind, src, payload = frame.recv_request(
+                    conn, self._max_frame,
+                )
+                try:
+                    status, reply = self._dispatch(kind, src, payload)
+                except ValueError as e:
+                    # the endpoints' documented rejection plane: counted
+                    # by the caller as a bad reply, never retried
+                    status, reply = frame.STATUS_REJECT, str(e).encode()
+                except Exception as e:   # server bug: retryable plane
+                    status, reply = (
+                        frame.STATUS_ERROR,
+                        f"{type(e).__name__}: {e}".encode()[:512],
+                    )
+                frame.send_reply(conn, status, reply)
+        except (ConnectionError, OSError):
+            pass   # client went away (incl. frame garbage): drop conn
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._stopping.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class NodeRuntime:
+    """The per-process composition: durable node + pool + server + loop.
+
+    Built from a *spec* dict (see :func:`main`): the member index, the
+    shared ``(n_nodes, seed)`` identity rule, the full host/port
+    topology, and the workdir holding this node's checkpoint/WAL/report
+    files.  The constructor performs the whole crash-recovery sequence —
+    WAL scan (+ startup post-mortem), checkpoint restore, WAL replay —
+    and :meth:`run` serves until a STOP request or the optional duration
+    elapses, then checkpoints, writes the report, and marks the WAL
+    clean.
+    """
+
+    def __init__(self, spec: Dict):
+        self.spec = spec
+        self.index = int(spec["index"])
+        self.n_nodes = int(spec["n_nodes"])
+        self.seed = int(spec.get("seed", 0))
+        self.host = spec.get("host", "127.0.0.1")
+        self.ports: List[int] = [int(p) for p in spec["ports"]]
+        self.workdir = spec["workdir"]
+        self.paths = derive_paths(self.workdir, self.index)
+        self.settings = resolve_net_settings()
+        self.settings.update(spec.get("net") or {})
+        self.duration_s = spec.get("duration_s")
+        self.label = f"n{self.index}"
+
+        keys = member_keys(self.n_nodes, self.seed)
+        self.pk, self.sk = keys[self.index]
+        self.members = [pk for pk, _ in keys]
+        self.config = SwirldConfig(n_members=self.n_nodes, seed=self.seed)
+
+        self.lock = threading.Lock()
+        self.stop = threading.Event()
+
+        # --- durability: WAL scan + startup post-mortem -------------------
+        self.wal = OwnEventWal(self.paths["wal"], pk=self.pk)
+        self.unclean_start = self.wal.unclean
+        self.flightrec = FlightRecorder(
+            dump_dir=spec.get("flightrec_dir"),
+            wall_clock=frame.now,
+            config=self.config,
+        )
+        self.flightrec_dump = startup_postmortem(
+            self.wal, self.flightrec, self.label,
+        )
+
+        # --- transport + node (checkpoint restore when one exists) -------
+        sock_transport = SocketTransport(settings=self.settings, src=self.pk)
+        for j, pk_j in enumerate(self.members):
+            if j != self.index:
+                sock_transport.register(pk_j, self.host, self.ports[j])
+        self.transport = sock_transport
+        yielding = _YieldingTransport(sock_transport, self.lock)
+        self.restored = os.path.exists(self.paths["ckpt"])
+        if self.restored:
+            self.node = load_node(
+                self.paths["ckpt"], sk=self.sk, pk=self.pk, network={},
+                transport=yielding,
+            )
+        else:
+            self.node = Node(
+                sk=self.sk, pk=self.pk, network={}, members=self.members,
+                config=self.config, transport=yielding,
+            )
+            # the genesis is durable before anything can be gossiped.
+            # On a crash *before the first checkpoint* the WAL already
+            # starts with this exact genesis (the lamport-clock genesis
+            # is bit-deterministic) — appending again would put it after
+            # the real tail and defeat the pull-only recovery guard.
+            if not self.wal.events:
+                self.wal.append(self.node.hg[self.node.head])
+        # real backoff: Node records logical delays; scale them onto the
+        # wall clock, capped so a long breaker cooldown cannot stall a
+        # whole gossip turn.  Runs with the lock held — yield it.
+        tick_s = float(self.settings["retry_tick_s"])
+
+        def _net_sleep(ticks: float) -> None:
+            self.lock.release()
+            try:
+                frame.sleep(min(ticks * tick_s, 0.5))
+            finally:
+                self.lock.acquire()
+
+        self.node._sleep = _net_sleep
+
+        # --- WAL replay: events since the last checkpoint -----------------
+        wal_ids: List[bytes] = []
+        self.node._ingest(self.wal.events, wal_ids)
+        if wal_ids:
+            self.node.consensus_pass(wal_ids)
+
+        # --- tx ingestion + finality tracking -----------------------------
+        self.pool = TxPool(
+            max_pool=self.settings["tx_pool_txs"],
+            batch_bytes=self.settings["tx_batch_bytes"],
+            max_tx_bytes=self.settings["tx_max_bytes"],
+            max_undecided=self.settings["max_undecided"],
+            window_fn=lambda: self.node.undecided_window,
+        )
+        self.tracker = FinalityTracker("cluster", clock=frame.now)
+        self.decided_txids: set = set()
+        self.decided_tx = 0
+        self._decided_watermark = 0
+        self._rng = random.Random(
+            int.from_bytes(
+                crypto.hash_bytes(b"netproc" + self.pk)[:8], "little",
+            )
+            ^ self.seed
+        )
+        self.server: Optional[NodeServer] = None
+
+    # ------------------------------------------------------------ dispatch
+
+    def dispatch(self, kind: int, src: bytes, payload: bytes,
+                 ) -> Tuple[int, bytes]:
+        """Serve one framed request (called from server threads)."""
+        if kind == frame.KIND_PING:
+            return frame.STATUS_OK, b"pong"
+        if kind == frame.KIND_STOP:
+            self.stop.set()
+            return frame.STATUS_OK, b"stopping"
+        if kind == frame.KIND_SUBMIT:
+            with self.lock:
+                accepted, reply = self.pool.submit(payload)
+                if accepted:
+                    self.tracker.mark_birth(crypto.hash_bytes(payload))
+            return frame.STATUS_OK, reply
+        if kind == frame.KIND_STATUS:
+            with self.lock:
+                body = json.dumps(self.status()).encode()
+            return frame.STATUS_OK, body
+        if kind == frame.KIND_SYNC:
+            with self.lock:
+                return frame.STATUS_OK, self.node.ask_sync(src, payload)
+        if kind == frame.KIND_WANT:
+            with self.lock:
+                return frame.STATUS_OK, self.node.ask_events(src, payload)
+        raise ValueError(f"unknown request kind {kind}")
+
+    # -------------------------------------------------------------- status
+
+    def status(self) -> Dict:
+        """Supervisor probe body (caller holds the lock)."""
+        node = self.node
+        return {
+            "index": self.index,
+            "pk": self.pk.hex(),
+            "events": len(node.hg),
+            "decided": len(node.consensus),
+            "decided_tx": self.decided_tx,
+            "undecided_window": node.undecided_window,
+            "pending_txs": len(self.pool.pending),
+            "recovering": self._recovering(),
+            "unclean_start": self.unclean_start,
+            "flightrec_dump": self.flightrec_dump,
+        }
+
+    def _recovering(self) -> bool:
+        """Pre-crash tip not yet re-reached: pull-only until it is, so
+        this node never signs below its own durable history (the
+        amnesia-fork guard the chaos harness pins in-process)."""
+        return bool(
+            self.wal.events
+            and self.node.head != self.wal.events[-1].id
+        )
+
+    # ----------------------------------------------------------- main loop
+
+    def _turn(self) -> None:
+        """One gossip turn (caller holds the lock)."""
+        node = self.node
+        peers = [m for m in self.members if m != self.pk]
+        peer = self._rng.choice(peers)
+        if self._recovering():
+            got = node.pull(peer)
+            if got:
+                node.consensus_pass(got)
+        else:
+            # a batch is only drained when the sync will actually create
+            # an event (sync is a no-op until the peer is known) — a
+            # batch fed to a no-op sync would be silently dropped
+            batch = (
+                self.pool.next_batch() if node.member_events[peer] else b""
+            )
+            prev_head = node.head
+            new_ids = node.sync(peer, batch)
+            if node.head != prev_head:
+                # durable BEFORE any peer can observe it: the lock is
+                # held until after this fsync completes
+                self.wal.append(node.hg[node.head])
+            if new_ids:
+                node.consensus_pass(new_ids)
+        self._record_decided()
+
+    def _record_decided(self) -> None:
+        """Walk newly decided events; record each decided transaction's
+        submission→decided latency (birth known only for txs submitted
+        to this node)."""
+        node = self.node
+        t = frame.now()
+        while self._decided_watermark < len(node.consensus):
+            eid = node.consensus[self._decided_watermark]
+            self._decided_watermark += 1
+            for tx in decode_batch(node.hg[eid].d):
+                txid = crypto.hash_bytes(tx)
+                if txid in self.decided_txids:
+                    continue
+                self.decided_txids.add(txid)
+                self.decided_tx += 1
+                self.tracker.record_decided(
+                    txid,
+                    node.round.get(eid, 0),
+                    node.round_received.get(eid, 0),
+                    now=t,
+                )
+
+    def _checkpoint(self) -> None:
+        """Atomic checkpoint + WAL prune (caller holds the lock): after
+        ``save_node`` covers everything in the store, only own events
+        the store does *not* hold (none, for a live node) stay in the
+        WAL — so the WAL is always exactly the tail since the last
+        checkpoint."""
+        save_node(self.paths["ckpt"], self.node)
+        self.wal.rewrite(
+            [ev for ev in self.wal.events if ev.id not in self.node.hg]
+        )
+
+    def run(self) -> int:
+        self.server = NodeServer(
+            self.host, self.ports[self.index], self.dispatch,
+            int(self.settings["max_frame_bytes"]),
+        )
+        # readiness marker: the server socket is accepting
+        with open(self.paths["ready"], "w") as f:
+            json.dump({"index": self.index, "pid": os.getpid()}, f)
+        t0 = frame.now()
+        interval = float(self.settings["gossip_interval_s"])
+        ckpt_every = float(self.settings["checkpoint_every_s"])
+        next_ckpt = t0 + ckpt_every
+        try:
+            while not self.stop.is_set():
+                if (
+                    self.duration_s is not None
+                    and frame.now() - t0 >= float(self.duration_s)
+                ):
+                    break
+                with self.lock:
+                    self._turn()
+                    if frame.now() >= next_ckpt:
+                        self._checkpoint()
+                        next_ckpt = frame.now() + ckpt_every
+                frame.sleep(interval)
+        finally:
+            self.server.close()
+        with self.lock:
+            self._record_decided()
+            self._checkpoint()
+            self._write_report()
+            self.wal.mark_clean()
+        self.transport.close()
+        return 0
+
+    # -------------------------------------------------------------- report
+
+    def _write_report(self) -> None:
+        node = self.node
+        counters: Dict[str, float] = dict(self.pool.counters)
+        counters["wal_torn_tail_recovered"] = self.wal.torn_tail_recovered
+        counters.update(
+            {f"net_{k}": v for k, v in sorted(self.transport.stats.items())}
+        )
+        counters["node_retries"] = node.retries
+        counters["node_bad_replies"] = node.bad_replies
+        counters["node_bad_requests"] = node.bad_requests
+        counters["node_circuit_opens"] = node.circuit_opens
+        report = {
+            "report_version": REPORT_VERSION,
+            "index": self.index,
+            "pk": self.pk.hex(),
+            "seed": self.seed,
+            "restored": self.restored,
+            "unclean_start": self.unclean_start,
+            "flightrec_dump": self.flightrec_dump,
+            "decided": [e.hex() for e in node.consensus],
+            "decided_tx": self.decided_tx,
+            "events": len(node.hg),
+            "counters": counters,
+            "finality": self.tracker.summary(),
+            "ttf_samples": list(self.tracker.ttf),
+        }
+        with open(self.paths["events"], "wb") as f:
+            f.write(
+                b"".join(encode_event(node.hg[e]) for e in node.order_added)
+            )
+        tmp = self.paths["report"] + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+        os.replace(tmp, self.paths["report"])
+
+
+def main(argv: List[str]) -> int:
+    if len(argv) != 1:
+        raise SystemExit(
+            "usage: python -m tpu_swirld.net.node_proc spec.json"
+        )
+    with open(argv[0]) as f:
+        spec = json.load(f)
+    return NodeRuntime(spec).run()
+
+
+if __name__ == "__main__":
+    import sys
+
+    raise SystemExit(main(sys.argv[1:]))

@@ -67,6 +67,20 @@ def _dist(samples: List[float], prefix: str, out: Dict) -> None:
     out[f"{prefix}_max"] = s[-1]
 
 
+def merged_dist(sample_lists: Sequence[Sequence[float]], prefix: str) -> Dict:
+    """Mean/p50/p99/max over the *union* of several trackers' raw
+    samples.  The cluster harness runs one tracker per OS process;
+    cluster-level submission→decided percentiles must rank the merged
+    samples, not average per-node percentiles (averaging percentiles is
+    statistically meaningless).  ``{}`` when no samples exist."""
+    merged = [float(x) for samples in sample_lists for x in samples]
+    out: Dict = {}
+    if merged:
+        _dist(merged, prefix, out)
+        out[f"{prefix}_count"] = len(merged)
+    return out
+
+
 class FinalityTracker:
     """Lifecycle tracker for one engine's decided events.
 

@@ -45,6 +45,7 @@ SCHEMA = "tpu-swirld-flightrec/1"
 #: trigger reasons wired in-tree (callers may add their own)
 REASONS = (
     "verdict_failed", "overflow_heal", "breaker_open", "rebase_storm",
+    "unclean_shutdown",
 )
 
 

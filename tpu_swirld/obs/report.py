@@ -9,9 +9,9 @@ and prints:
    their recorded depth;
 2. the *protocol gauges* — every counter sample (``ph: "C"``) embedded in
    the trace, i.e. the registry snapshot at save time — split into
-   protocol / store / finality (rounds-to-decision, time-to-finality,
-   decided watermarks) / flight-recorder (trigger + dump counters) /
-   resilience sections.
+   protocol / store / cluster-traffic (tx ingestion, WAL recovery) /
+   finality (rounds-to-decision, time-to-finality, decided watermarks) /
+   flight-recorder (trigger + dump counters) / resilience sections.
 
 Pure stdlib + pure functions over the event list, so the CLI can be smoke-
 tested cheaply (``tests/test_obs.py``) and never rots silently.
@@ -118,6 +118,20 @@ def is_store_row(g: Dict) -> bool:
     return any(g["name"].startswith(p) for p in _STORE_PREFIXES)
 
 
+# The real-cluster traffic surface: tx ingestion/backpressure counters
+# (TxPool), durable-WAL recovery counters, and the socket transport's
+# byte/timeout counters already covered by transport_ above.
+_NET_PREFIXES = (
+    "tx_",
+    "wal_",
+    "net_",
+)
+
+
+def is_net_row(g: Dict) -> bool:
+    return any(g["name"].startswith(p) for p in _NET_PREFIXES)
+
+
 # The finality lifecycle surface: rounds-to-decision / time-to-finality
 # histogram rows (per engine, with the streaming phase dimension),
 # gossip-propagation latency, and per-node decided-watermark gauges.
@@ -165,19 +179,27 @@ def render_report(events: List[Dict]) -> str:
         g for g in gauges
         if is_store_row(g) and not is_resilience_row(g)
     ]
+    net = [
+        g for g in gauges
+        if is_net_row(g)
+        and not is_resilience_row(g) and not is_store_row(g)
+    ]
     finality = [
         g for g in gauges
         if is_finality_row(g)
         and not is_resilience_row(g) and not is_store_row(g)
+        and not is_net_row(g)
     ]
     flightrec = [
         g for g in gauges
         if is_flightrec_row(g)
         and not is_resilience_row(g) and not is_store_row(g)
+        and not is_net_row(g)
     ]
     protocol = [
         g for g in gauges
         if not is_resilience_row(g) and not is_store_row(g)
+        and not is_net_row(g)
         and not is_finality_row(g) and not is_flightrec_row(g)
     ]
     lines.append("")
@@ -193,6 +215,12 @@ def render_report(events: List[Dict]) -> str:
         lines.append("== store (tile budget / archive / spill overlap) ==")
         width = max(len(_gauge_name(g)) for g in store)
         for g in store:
+            lines.append(f"{_gauge_name(g):<{width}}  {g['value']}")
+    if net:
+        lines.append("")
+        lines.append("== cluster traffic (tx ingestion / WAL recovery) ==")
+        width = max(len(_gauge_name(g)) for g in net)
+        for g in net:
             lines.append(f"{_gauge_name(g):<{width}}  {g['value']}")
     if finality:
         lines.append("")

@@ -259,6 +259,15 @@ class Node:
         """Lifetime circuit-breaker open transitions (gauge surface)."""
         return self.breaker.opens if self.breaker else 0
 
+    @property
+    def undecided_window(self) -> int:
+        """Events in store not yet in the decided order — how far
+        consensus trails ingest.  The admission-control gauge: the tx
+        ingestion layer (:mod:`tpu_swirld.net.ingest`) sheds client
+        submissions while this exceeds its threshold, so an overloaded
+        node backpressures instead of growing an unbounded queue."""
+        return len(self.hg) - len(self.consensus)
+
     def _now(self) -> int:
         t = int(self._clock())
         if self.head is not None:

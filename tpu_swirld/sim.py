@@ -70,6 +70,17 @@ def attach_obs(
         wire_node(node, flightrec, label)
 
 
+def member_keys(n_nodes: int, seed: int = 0) -> List[Tuple[bytes, bytes]]:
+    """Deterministic member keypairs for ``(n_nodes, seed)`` — the ONE
+    key-derivation rule (see :class:`Population`).  Factored out so a
+    cluster node process (:mod:`tpu_swirld.net.node_proc`), holding only
+    its index and the shared seed, derives the same identities as the
+    in-process harnesses and the oracle replay."""
+    return [
+        crypto.keypair(b"member-%d-%d" % (seed, i)) for i in range(n_nodes)
+    ]
+
+
 @dataclasses.dataclass
 class Population:
     """Shared bootstrap of a gossip population: deterministic member
@@ -100,7 +111,7 @@ def build_population(
     :class:`~tpu_swirld.transport.Transport`.
     """
     rng = random.Random(seed)
-    keys = [crypto.keypair(b"member-%d-%d" % (seed, i)) for i in range(n_nodes)]
+    keys = member_keys(n_nodes, seed)
     members = [pk for pk, _ in keys]
     network: Dict[bytes, Callable] = {}
     network_want: Dict[bytes, Callable] = {}

@@ -123,6 +123,12 @@ class Transport:
                 f"peer endpoint error: {type(e).__name__}"
             ) from e
 
+    def close(self) -> None:
+        """Release delivery resources.  A no-op for the in-process
+        transports; the socket transport (:class:`tpu_swirld.net.
+        transport.SocketTransport`) overrides it to drop its per-peer
+        connections — callers tear any transport down uniformly."""
+
 
 # --------------------------------------------------------------- fault plan
 
