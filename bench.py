@@ -539,6 +539,66 @@ def run_stream(tile_budget, tile, mesh_n=0, device_tile_budget=None):
     log(f"[store] {json.dumps(stats)} budget_ok={budget_ok}"
         + (f" dev_budget_ok={dev_budget_ok}" if mesh_n else ""))
 
+    # ---- dispatch-level hot-path profile (ROADMAP item 4: measure the
+    # per-chunk dispatch / host-device cost instead of guessing).  Two
+    # single-device passes over the same stream prefix on the now-warm
+    # jit caches: one untraced (the control), one under a
+    # DispatchProfiler + tracer — the delta is the measured
+    # tracing/profiling overhead, gated <= 5% by bench_compare.py.
+    profile_events = int(os.environ.get(
+        "BENCH_STREAM_PROFILE", str(3 * STREAM_CHUNK)
+    ))
+    dispatch_out = None
+    if profile_events:
+        profile_events = min(profile_events, STREAM_EVENTS)
+        from tpu_swirld import obs as obs_mod
+        from tpu_swirld.obs.profile import DispatchProfiler
+
+        def _profile_pass(enabled_obs):
+            _m3, _s3, _k3, prof_chunks = stream_gossip_dag(
+                STREAM_MEMBERS, profile_events, STREAM_CHUNK, seed=1
+            )
+            eng = StreamingConsensus(
+                members, stake, cfg,
+                tile_budget=tile_budget, tile=tile,
+                ingest_chunk=STREAM_CHUNK, window_bucket=2048,
+                prune_min=1024,
+            )
+            t0 = time.time()
+            if enabled_obs is not None:
+                with obs_mod.enabled(enabled_obs):
+                    for chunk in prof_chunks:
+                        eng.ingest(chunk)
+            else:
+                for chunk in prof_chunks:
+                    eng.ingest(chunk)
+            dt = time.time() - t0
+            eng.store.close()
+            return dt
+
+        with mon.phase("stream_profile"):
+            t_plain = _profile_pass(None)
+            prof = DispatchProfiler()
+            t_prof = _profile_pass(obs_mod.Obs(profiler=prof))
+        overhead_ratio = max(0.0, (t_prof - t_plain) / t_plain)
+        dispatch_out = prof.summary()
+        dispatch_out["profiled_events"] = profile_events
+        dispatch_out["plain_s"] = round(t_plain, 6)
+        dispatch_out["profiled_s"] = round(t_prof, 6)
+        dispatch_out["trace_overhead_ratio"] = round(overhead_ratio, 4)
+        top = ", ".join(
+            f"{t['stage']}={t['seconds']:.3f}s/{t['calls']}x"
+            for t in dispatch_out["top_stages"]
+        )
+        log(f"[dispatch] {profile_events} ev profiled: "
+            f"wall={dispatch_out['wall_s']:.3f}s "
+            f"stage={dispatch_out['stage_s']:.3f}s "
+            f"overhead={dispatch_out['dispatch_overhead_s']:.3f}s "
+            f"h2d={dispatch_out['transfers_bytes']['h2d']} "
+            f"d2h={dispatch_out['transfers_bytes']['d2h']} "
+            f"top[{top}] "
+            f"trace_overhead={overhead_ratio:.1%}")
+
     mesh_out = None
     if mesh_n:
         # single-device reference for the scaling number: an external
@@ -633,6 +693,16 @@ def run_stream(tile_budget, tile, mesh_n=0, device_tile_budget=None):
             "oracle_decided": len(oracle.consensus),
             "compile_cache": bool(cache_dir),
             "parity": bool(parity),
+            # dotted keys bench_compare.py gates directly
+            "dispatch_overhead_s": (
+                dispatch_out["dispatch_overhead_s"]
+                if dispatch_out is not None else None
+            ),
+            "trace_overhead_ratio": (
+                dispatch_out["trace_overhead_ratio"]
+                if dispatch_out is not None else None
+            ),
+            "dispatch": dispatch_out,
         },
         "finality": {
             "streaming": {
@@ -824,6 +894,16 @@ def run_cluster():
             "overload_shed": shed,
             "wal_torn_tail_recovered":
                 verdict["counters"]["wal_torn_tail_recovered"],
+            # telemetry-plane artifacts: the merged cross-process trace
+            # and the supervisor metrics rollup, so BENCH_r*.json is
+            # self-describing for the trajectory tooling
+            "merged_trace": (verdict.get("trace") or {}).get("merged"),
+            "cross_process_traces":
+                (verdict.get("trace") or {}).get("cross_process_traces"),
+            "metrics_rollup": (verdict.get("metrics") or {}).get("json"),
+            "metrics_prom": (verdict.get("metrics") or {}).get("prom"),
+            "metrics_nodes_covered":
+                (verdict.get("metrics") or {}).get("nodes_covered"),
         },
         "lint": lint_stamp(),
         "mc": mc_stamp(),

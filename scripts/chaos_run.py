@@ -78,6 +78,34 @@ def _flightrec(args):
     return FlightRecorder(dump_dir=args.flightrec_dir)
 
 
+def flightrec_dumps_by_trace(dump_dir) -> dict:
+    """Group the post-mortem dumps in ``dump_dir`` by the trace id each
+    one embedded at dump time (PR 16: snapshots carry ``trace_id`` /
+    ``node_name``) — a red verdict's forensics index directly to the
+    cross-process trace that was in flight.  Dumps predating the field
+    (or outside any traced span) group under ``"untraced"``."""
+    from tpu_swirld.obs.flightrec import load_dump
+
+    out: dict = {}
+    if not dump_dir or not os.path.isdir(dump_dir):
+        return out
+    for name in sorted(os.listdir(dump_dir)):
+        if not (name.startswith("flightrec_") and name.endswith(".json")):
+            continue
+        path = os.path.join(dump_dir, name)
+        try:
+            body = load_dump(path)
+        except (OSError, ValueError):
+            continue
+        trace = body.get("trace_id") or "untraced"
+        out.setdefault(trace, []).append({
+            "path": path,
+            "node_name": body.get("node_name"),
+            "reason": body.get("reason"),
+        })
+    return out
+
+
 def _run_acceptance(args, ckpt_dir, o) -> dict:
     """The composed fault scenario: lossy/reordering transport, one
     scheduled partition + heal, one crash + checkpoint-restart, optional
@@ -352,6 +380,9 @@ def main(argv=None) -> int:
             "ok": all(v["ok"] for v in results.values()),
             "scenarios": results,
         }
+        if args.flightrec_dir:
+            verdict["flightrec_dumps_by_trace"] = \
+                flightrec_dumps_by_trace(args.flightrec_dir)
         with open(args.out, "w") as f:
             json.dump(verdict, f, indent=2, sort_keys=True)
         print(f"verdict: {'OK' if verdict['ok'] else 'FAIL'} -> {args.out}")
@@ -380,10 +411,14 @@ def main(argv=None) -> int:
     if args.mc:
         verdict["mc"] = run_mc_section(args)
         verdict["ok"] = bool(verdict["ok"] and verdict["mc"]["ok"])
+    if args.flightrec_dir:
+        verdict["flightrec_dumps_by_trace"] = \
+            flightrec_dumps_by_trace(args.flightrec_dir)
     with open(args.out, "w") as f:
         json.dump(verdict, f, indent=2, sort_keys=True)
     for key in ("safety", "liveness", "horizon", "fork_storm", "round_clamp",
-                "adversary", "engines", "sanitizer", "mc", "flightrec_dump"):
+                "adversary", "engines", "sanitizer", "mc", "flightrec_dump",
+                "flightrec_dumps_by_trace"):
         if key in verdict:
             print(json.dumps({key: verdict[key]}, sort_keys=True))
     print(f"verdict: {'OK' if verdict['ok'] else 'FAIL'} -> {args.out}")

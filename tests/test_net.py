@@ -25,6 +25,7 @@ from tpu_swirld.net.transport import SocketTransport
 from tpu_swirld.net.wal import MAGIC, TAG_EVENT, OwnEventWal
 from tpu_swirld.net.node_proc import NodeServer, startup_postmortem
 from tpu_swirld.obs.flightrec import FlightRecorder, load_dump
+from tpu_swirld.obs.tracer import pack_context
 from tpu_swirld.oracle.event import Event, encode_event
 from tpu_swirld.oracle.node import Node
 from tpu_swirld.transport import (
@@ -45,14 +46,15 @@ def test_frame_request_reply_roundtrip():
     a, b = _pair()
     try:
         frame.send_request(a, frame.KIND_SYNC, b"S" * 32, b"payload-bytes")
-        kind, src, payload = frame.recv_request(b)
-        assert (kind, src, payload) == (frame.KIND_SYNC, b"S" * 32,
-                                        b"payload-bytes")
+        kind, src, payload, trace = frame.recv_request(b)
+        assert (kind, src, payload, trace) == (
+            frame.KIND_SYNC, b"S" * 32, b"payload-bytes", b"",
+        )
         frame.send_reply(b, frame.STATUS_OK, b"reply-bytes")
         assert frame.recv_reply(a) == (frame.STATUS_OK, b"reply-bytes")
         # empty src and empty payload are legal frames
         frame.send_request(a, frame.KIND_PING, b"", b"")
-        assert frame.recv_request(b) == (frame.KIND_PING, b"", b"")
+        assert frame.recv_request(b) == (frame.KIND_PING, b"", b"", b"")
     finally:
         a.close()
         b.close()
@@ -105,6 +107,137 @@ def test_frame_eof_mid_frame_is_connection_error():
             frame.recv_request(b)
     finally:
         b.close()
+
+
+def test_frame_trace_context_roundtrip():
+    """A traced frame carries its 16-byte context between src and
+    payload; the receiver masks the flag off the kind byte."""
+    ctx = pack_context(b"trace-id", 0x1234)
+    a, b = _pair()
+    try:
+        frame.send_request(a, frame.KIND_SUBMIT, b"S" * 8, b"tx", trace=ctx)
+        assert frame.recv_request(b) == (frame.KIND_SUBMIT, b"S" * 8,
+                                         b"tx", ctx)
+        # empty src / empty payload still frame correctly with a trace
+        frame.send_request(a, frame.KIND_SYNC, b"", b"", trace=ctx)
+        assert frame.recv_request(b) == (frame.KIND_SYNC, b"", b"", ctx)
+    finally:
+        a.close()
+        b.close()
+    # a wrong-sized context is the sender's bug, refused before the wire
+    a, b = _pair()
+    try:
+        with pytest.raises(ValueError):
+            frame.send_request(a, frame.KIND_SYNC, b"", b"x", trace=b"short")
+    finally:
+        a.close()
+        b.close()
+    # a flagged frame too short for its context is connection garbage
+    body = frame._REQ_HEAD.pack(frame.KIND_SYNC | frame.TRACE_FLAG, 0) + b"123"
+    _expect_frame_error(
+        struct.pack("<I", len(body)) + body, frame.recv_request,
+    )
+
+
+def test_frame_old_header_parses_under_new_decoder():
+    """Wire compat, old sender -> new receiver: a hand-built pre-trace
+    frame (no flag, no context) decodes exactly as before with an empty
+    trace — untraced frames are byte-identical to the old format."""
+    src, payload = b"oldpk", b"old-payload"
+    body = frame._REQ_HEAD.pack(frame.KIND_SYNC, len(src)) + src + payload
+    a, b = _pair()
+    try:
+        a.sendall(struct.pack("<I", len(body)) + body)
+        assert frame.recv_request(b) == (frame.KIND_SYNC, src, payload, b"")
+        # and the new sender's untraced output IS that old byte layout
+        frame.send_request(a, frame.KIND_SYNC, src, payload)
+    finally:
+        a.close()
+    try:
+        raw = frame.recv_exact(b, 4 + len(body))
+        assert raw == struct.pack("<I", len(body)) + body
+    finally:
+        b.close()
+
+
+def _pre_trace_recv_request(sock, max_frame=frame.MAX_FRAME_BYTES):
+    """The decoder as it shipped BEFORE the trace-context header: no
+    flag masking — a flagged kind byte surfaces verbatim.  Kept as a
+    test stub to pin how an old node reacts to a new traced frame."""
+    (nbytes,) = struct.unpack("<I", frame.recv_exact(sock, 4))
+    if nbytes < frame._REQ_HEAD.size or nbytes > max_frame:
+        raise FrameError(f"bad request frame length {nbytes}")
+    body = frame.recv_exact(sock, nbytes)
+    kind, src_len = frame._REQ_HEAD.unpack_from(body)
+    off = frame._REQ_HEAD.size + src_len
+    if off > len(body):
+        raise FrameError(f"request src overruns frame ({src_len} bytes)")
+    return kind, body[frame._REQ_HEAD.size:off], body[off:]
+
+
+def test_frame_new_header_rejected_cleanly_by_pre_trace_decoder():
+    """Wire compat, new sender -> old receiver: the flagged kind byte
+    decodes to an *unknown* kind (0x80 | kind), which every dispatch
+    layer rejects via its documented unknown-kind ``ValueError`` path —
+    a clean REJECT, never a misparse into a real request."""
+    ctx = pack_context(b"trace-id", 7)
+    a, b = _pair()
+    try:
+        frame.send_request(a, frame.KIND_SUBMIT, b"pk", b"tx-bytes",
+                           trace=ctx)
+        kind, src, payload = _pre_trace_recv_request(b)
+        assert kind == (frame.KIND_SUBMIT | frame.TRACE_FLAG)
+        known = {frame.KIND_SYNC, frame.KIND_WANT, frame.KIND_SUBMIT,
+                 frame.KIND_STATUS, frame.KIND_STOP, frame.KIND_PING,
+                 frame.KIND_METRICS}
+        assert kind not in known   # -> the unknown-kind REJECT path
+        # framing itself stays sound: src parses, the context rides
+        # inside what the old decoder sees as payload, nothing misaligns
+        assert src == b"pk"
+        assert payload == ctx + b"tx-bytes"
+        with pytest.raises(ValueError):
+            raise ValueError("unknown kind %d" % kind)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_wal_records_carry_no_trace_bytes(tmp_path):
+    """Trace ids are transport-only: the WAL byte stream is a pure
+    function of the events — appending under an active traced span
+    writes byte-identical records, and torn-tail recovery of such a file
+    hands back events with nothing trace-related attached."""
+    from tpu_swirld.obs.tracer import Tracer
+
+    pk, sk = crypto.keypair(b"wal-trace-free")
+    evs = _own_events(pk, sk, 3)
+    plain, traced = str(tmp_path / "plain.wal"), str(tmp_path / "traced.wal")
+    w = OwnEventWal(plain, pk=pk)
+    for ev in evs:
+        w.append(ev)
+    w.close()
+    tr = Tracer(pid=9)
+    ctx = pack_context(b"\xabtrace!!", 0)
+    with tr.span_under("gossip.sync", ctx):
+        w2 = OwnEventWal(traced, pk=pk)
+        for ev in evs:
+            w2.append(ev)
+        w2.close()
+    with open(plain, "rb") as f:
+        plain_bytes = f.read()
+    with open(traced, "rb") as f:
+        traced_bytes = f.read()
+    assert plain_bytes == traced_bytes
+    assert ctx not in traced_bytes and b"\xabtrace!!" not in traced_bytes
+    # torn-tail recovery of the traced-context file: same durable prefix,
+    # and recovered events expose exactly the Event surface — no trace
+    with open(traced, "wb") as f:
+        f.write(traced_bytes[:-3])
+    t = OwnEventWal(traced, pk=pk)
+    assert t.torn_tail_recovered == 1
+    assert [e.id for e in t.events] == [e.id for e in evs[:-1]]
+    assert not any(hasattr(e, "trace") for e in t.events)
+    t.close()
 
 
 def test_allocate_ports_distinct_and_bindable():
@@ -414,7 +547,7 @@ def test_net_package_wall_clock_surface_is_exactly_frame():
 
 
 def _serve_node(node, port):
-    def dispatch(kind, src, payload):
+    def dispatch(kind, src, payload, trace=b""):
         if kind == frame.KIND_SYNC:
             return frame.STATUS_OK, node.ask_sync(src, payload)
         if kind == frame.KIND_WANT:
@@ -546,7 +679,7 @@ def test_socket_transport_status_reject_and_error_planes():
     (port,) = allocate_ports(1)
     mode = {"raise": ValueError("bad request payload")}
 
-    def dispatch(kind, src, payload):
+    def dispatch(kind, src, payload, trace=b""):
         raise mode["raise"]
 
     server = NodeServer("127.0.0.1", port, dispatch, frame.MAX_FRAME_BYTES)
@@ -581,7 +714,7 @@ def test_socket_transport_redials_stale_cached_connection():
 
     def one_shot():
         conn, _addr = ls.accept()
-        _kind, _src, payload = frame.recv_request(conn)
+        _kind, _src, payload, _trace = frame.recv_request(conn)
         frame.send_reply(conn, frame.STATUS_OK, b"pong:" + payload)
         conn.close()
         ls.close()
@@ -599,7 +732,7 @@ def test_socket_transport_redials_stale_cached_connection():
         t.join(5)
         assert not t.is_alive()
 
-        def dispatch(kind, src, payload):
+        def dispatch(kind, src, payload, trace=b""):
             return frame.STATUS_OK, b"pong:" + payload
 
         server = NodeServer(
@@ -657,8 +790,8 @@ def test_node_server_worker_threads_keep_no_state():
     seen = []
     done = threading.Event()
 
-    def dispatch(kind, src, payload):
-        seen.append((kind, src, payload))
+    def dispatch(kind, src, payload, trace=b""):
+        seen.append((kind, src, payload, trace))
         done.set()
         return frame.STATUS_OK, b"ok"
 
@@ -669,6 +802,6 @@ def test_node_server_worker_threads_keep_no_state():
             frame.send_request(s, frame.KIND_PING, b"me", b"probe")
             assert frame.recv_reply(s) == (frame.STATUS_OK, b"ok")
         assert done.wait(5)
-        assert seen == [(frame.KIND_PING, b"me", b"probe")]
+        assert seen == [(frame.KIND_PING, b"me", b"probe", b"")]
     finally:
         server.close()

@@ -356,3 +356,380 @@ def test_report_cli_smoke(tmp_path):
     assert "pipeline.visibility_stage" in r.stdout
     assert "gossip_syncs" in r.stdout           # registry snapshot made it
     assert "round_fame_decided" in r.stdout     # viz gauges made it
+
+
+# --------------------------------- telemetry plane (PR 16): trace identity
+
+
+def test_pack_unpack_context_roundtrip_and_errors():
+    from tpu_swirld.obs.tracer import (
+        TRACE_CTX_LEN, pack_context, unpack_context,
+    )
+
+    ctx = pack_context(b"8bytesid", 0xDEADBEEF01)
+    assert len(ctx) == TRACE_CTX_LEN
+    assert unpack_context(ctx) == (b"8bytesid", 0xDEADBEEF01)
+    with pytest.raises(ValueError):
+        pack_context(b"short", 1)
+    with pytest.raises(ValueError):
+        unpack_context(ctx + b"x")
+    with pytest.raises(ValueError):
+        unpack_context(b"")
+
+
+def test_span_ids_are_process_unique_and_parenting_crosses_processes():
+    """The cluster-trace identity model: every enabled span gets a
+    pid-folded unique id; span_under parents a local span beneath a
+    remote one via the 16-byte wire context; active_context exports the
+    innermost traced span for the transport to stamp."""
+    from tpu_swirld.obs.tracer import pack_context, unpack_context
+
+    client = Tracer(pid=1000)
+    node = Tracer(pid=3)
+    root_ctx = pack_context(b"trace-00", 0)   # parent 0 = trace root
+    with client.span_under("client.submit", root_ctx) as root:
+        wire = client.active_context()
+        assert wire is not None
+        tid, parent = unpack_context(wire)
+        assert tid == b"trace-00" and parent == root.span_id
+    # "another process": a different tracer parents under the wire bytes
+    with node.span_under("node.submit", wire) as child:
+        inner_wire = node.active_context()
+        with node.span("node.inner"):   # plain child inherits the trace
+            pass
+    ev_root = client.events[-1]
+    ev_inner, ev_child = node.events[-2], node.events[-1]
+    assert ev_root["args"]["span_id"] == root.span_id
+    assert ev_root["args"]["trace"] == b"trace-00".hex()
+    assert "parent_span_id" not in ev_root["args"]   # root of the trace
+    assert ev_child["args"]["parent_span_id"] == root.span_id
+    assert ev_child["args"]["trace"] == ev_root["args"]["trace"]
+    assert ev_inner["args"]["parent_span_id"] == child.span_id
+    assert ev_inner["args"]["trace"] == ev_root["args"]["trace"]
+    # ids never collide across processes: pid lives in the upper bits
+    assert root.span_id >> 32 == (1000 & 0xFFFF) + 1
+    assert child.span_id >> 32 == 3 + 1
+    # outside any span there is nothing to stamp
+    assert client.active_context() is None
+    assert unpack_context(inner_wire)[1] == child.span_id
+
+
+def test_tracer_event_cap_counts_drops():
+    t = Tracer(max_events=2)
+    for i in range(5):
+        with t.span("s%d" % i):
+            pass
+    assert len(t.events) == 2 and t.dropped == 3
+
+
+def test_untraced_spans_carry_no_trace_keys():
+    """The pre-PR span shape is preserved: spans outside any trace emit
+    span_id (new, additive) but neither trace nor parent-pointer keys
+    beyond the local parent."""
+    t = Tracer()
+    with t.span("plain_outer"):
+        with t.span("plain_inner"):
+            pass
+    inner, outer = t.events
+    assert "trace" not in outer["args"] and "trace" not in inner["args"]
+    assert "parent_span_id" not in outer["args"]
+    assert inner["args"]["parent_span_id"] == outer["args"]["span_id"]
+    assert t.active_context() is None
+
+
+# ------------------------------------- telemetry plane: dispatch profiler
+
+
+def test_dispatch_profiler_chunk_accounting_with_injected_clock():
+    import numpy as np
+
+    from tpu_swirld.obs.profile import DispatchProfiler
+
+    ticks = iter([100.0, 110.0])   # begin_chunk, end_chunk
+    prof = DispatchProfiler(top_k=2, clock=lambda: next(ticks))
+    prof.begin_chunk(label="c0")
+    # two dispatches: 3s stage A, 2s stage B, 1s gap between them
+    prof.record_dispatch("A", 100.0, 103.0,
+                         args=(np.zeros(4, dtype=np.uint8),))
+    prof.record_dispatch("B", 104.0, 106.0)
+    prof.record_dispatch("A", 106.0, 107.0)
+    prof.record_transfer("d2h", 32)
+    row = prof.end_chunk(n_events=7)
+    assert row["label"] == "c0" and row["n_events"] == 7
+    assert row["dispatches"] == 3
+    assert row["stage_s"] == pytest.approx(6.0)
+    assert row["wall_s"] == pytest.approx(10.0)
+    assert row["overhead_s"] == pytest.approx(4.0)   # wall - stage
+    assert row["gap_s"] == pytest.approx(1.0)        # only B<-A gap
+    assert row["h2d_bytes"] == 4 and row["d2h_bytes"] == 32
+    s = prof.summary()
+    assert s["chunks"] == 1 and s["dispatches"] == 3
+    assert s["dispatch_overhead_s"] == pytest.approx(4.0)
+    assert s["transfers_bytes"] == {"h2d": 4, "d2h": 32}
+    # ranked by total seconds, name-stable
+    assert [r["stage"] for r in s["top_stages"]] == ["A", "B"]
+    assert s["top_stages"][0]["seconds"] == pytest.approx(4.0)
+    assert s["top_stages"][0]["calls"] == 2
+
+
+def test_dispatch_profiler_gaps_reset_at_chunk_boundaries():
+    from tpu_swirld.obs.profile import DispatchProfiler
+
+    ticks = iter([0.0, 10.0, 10.0, 20.0])
+    prof = DispatchProfiler(clock=lambda: next(ticks))
+    prof.begin_chunk()
+    prof.record_dispatch("A", 1.0, 2.0)
+    prof.end_chunk()
+    prof.begin_chunk()
+    # 9 seconds since the last dispatch of chunk 0 — NOT a gap: the
+    # wait between chunks is the caller's data generation
+    prof.record_dispatch("A", 11.0, 12.0)
+    prof.end_chunk()
+    assert prof.gap_s_total == 0.0
+    assert all(c["gap_s"] == 0.0 for c in prof.chunks)
+
+
+def test_stage_call_feeds_ambient_profiler_execute_only():
+    """The obs.stage_call seam: execute dispatches feed the profiler,
+    compiles are excluded (one-time cost), and obs.to_host counts D2H."""
+    import numpy as np
+
+    from tpu_swirld.obs.profile import DispatchProfiler
+
+    import jax
+
+    @jax.jit
+    def f(x):
+        return x + 1
+
+    prof = DispatchProfiler()
+    with obs.enabled(obs.Obs(profiler=prof)):
+        prof.begin_chunk()
+        obs.stage_call("stage.f", f, np.arange(8, dtype=np.int32))  # compile
+        obs.stage_call("stage.f", f, np.arange(8, dtype=np.int32))  # execute
+        host = obs.to_host(f(np.arange(8, dtype=np.int32)))
+        prof.end_chunk(n_events=8)
+    assert prof.dispatches == 1          # the compile call was excluded
+    assert prof.h2d_bytes == 32          # one numpy arg on the execute
+    assert prof.d2h_bytes == host.nbytes
+    assert prof.chunks[0]["dispatches"] == 1
+
+
+# --------------------------------------- telemetry plane: shard merging
+
+
+def _shard_event(name, pid, ts, wall_s, span_id, trace=None, parent=None):
+    args = {"depth": 0, "wall_s": wall_s, "span_id": span_id}
+    if trace is not None:
+        args["trace"] = trace
+    if parent is not None:
+        args["parent_span_id"] = parent
+    return {"name": name, "ph": "X", "pid": pid, "tid": 0,
+            "ts": ts, "dur": 500.0, "args": args}
+
+
+def test_cluster_trace_merge_rebases_and_links_cross_process(tmp_path):
+    from tpu_swirld.obs import cluster_trace
+
+    trace = "aabbccdd00112233"
+    # client shard: epoch ~= wall 100.0, root span of the trace
+    client = [_shard_event("client.submit", 1000, 0.0, 100.0, 7,
+                           trace=trace)]
+    # node shard: different epoch (ts 5000 at wall 100.001) — the merger
+    # must rebase both onto one timebase before comparing ts
+    node = [
+        _shard_event("node.submit", 3, 5000.0, 100.001, 99,
+                     trace=trace, parent=7),
+        _shard_event("node.local", 3, 6000.0, 100.002, 100, parent=99),
+    ]
+    (tmp_path / "client.trace.jsonl").write_text(
+        "\n".join(json.dumps(e) for e in client) + "\n")
+    (tmp_path / "node-0.trace.jsonl").write_text(
+        "\n".join(json.dumps(e) for e in node) + "\n")
+    out_path = str(tmp_path / "merged.trace.json")
+    summary = cluster_trace.merge_dir(str(tmp_path), out_path=out_path)
+    assert summary["shards"] == [
+        str(tmp_path / "client.trace.jsonl"),
+        str(tmp_path / "node-0.trace.jsonl"),
+    ]
+    assert summary["traces"] == 1
+    assert summary["cross_process_traces"] == 1
+    assert summary["cross_process_trace_ids"] == [trace]
+    info = summary["per_trace"][trace]
+    assert info["spans"] == 2 and info["pids"] == [0, 1]
+    assert info["edges"] == 1 and info["cross_process_edges"] == 1
+    with open(out_path) as f:
+        merged = json.load(f)["traceEvents"]
+    # shard labels became process_name metadata on renumbered pids
+    names = {e["pid"]: e["args"]["name"]
+             for e in merged if e.get("ph") == "M"}
+    assert names == {0: "client", 1: "n0"}
+    # rebasing: node.submit lands ~1000us after client.submit, not -5000
+    by_name = {e["name"]: e for e in merged if e.get("ph") == "X"}
+    delta = by_name["node.submit"]["ts"] - by_name["client.submit"]["ts"]
+    assert delta == pytest.approx(1000.0, abs=1.0)
+    # the cross-process edge became a flow arrow pair (s on the parent's
+    # pid/ts, f on the child's)
+    flows = [e for e in merged if e.get("ph") in ("s", "f")]
+    assert [(e["ph"], e["pid"]) for e in flows] == [("s", 0), ("f", 1)]
+    assert flows[0]["id"] == flows[1]["id"]
+
+
+def test_cluster_trace_merge_is_pure_and_empty_dir_ok(tmp_path):
+    from tpu_swirld.obs import cluster_trace
+
+    s1 = cluster_trace.merge_dir(str(tmp_path))
+    assert s1["events"] == 0 and s1["traces"] == 0
+    assert s1["cross_process_traces"] == 0
+
+
+# ------------------------------- telemetry plane: registry sample plane
+
+
+def test_registry_samples_roundtrip_merge_and_rollup():
+    from tpu_swirld.obs.registry import (
+        Registry, merge_node_samples, rollup_node_samples,
+    )
+
+    def make(node_scale):
+        r = Registry()
+        r.counter("tx_accepted").inc(10 * node_scale)
+        r.gauge("pending_txs").set(3 * node_scale)
+        h = r.histogram("ttf_seconds", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5 * node_scale)
+        return r
+
+    per_node = {
+        "n0": make(1).to_samples(),
+        "n1": make(2).to_samples(),
+    }
+    # load_samples round-trips a registry through its sample form
+    r2 = Registry()
+    r2.load_samples(per_node["n0"])
+    assert r2.to_samples() == per_node["n0"]
+    # merged exposition: one family, node label per sample
+    text = merge_node_samples(per_node).to_prometheus_text()
+    assert 'tx_accepted{node="n0"} 10' in text
+    assert 'tx_accepted{node="n1"} 20' in text
+    assert 'pending_txs{node="n1"} 6' in text
+    # cluster rollup: counters and gauges sum, histograms roll count
+    rollup = rollup_node_samples(per_node)
+    assert rollup["tx_accepted"] == 30
+    assert rollup["pending_txs"] == 9
+    assert rollup["ttf_seconds_count"] == 4
+
+
+# ----------------------------------- telemetry plane: report CLI modes
+
+
+def test_report_degrades_gracefully_on_bench_artifact(tmp_path, capsys):
+    """An old BENCH_*.json (plain result doc, pretty-printed) renders
+    n/a sections and exits 0 instead of crashing the CLI."""
+    from tpu_swirld.obs.report import main as report_main
+
+    path = str(tmp_path / "BENCH_r99.json")
+    with open(path, "w") as f:
+        json.dump({
+            "n": 1, "cmd": "python bench.py", "rc": 0,
+            "parsed": {"metric": "events/sec", "value": 123.0,
+                       "unit": "events/s"},
+        }, f, indent=2)
+    rc = report_main(["report", path])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "n/a" in out and "bench artifact" in out
+    assert "events/sec: 123.0 events/s" in out
+    # a real (single-line JSONL) trace still renders the normal report
+    tpath = str(tmp_path / "t.trace.jsonl")
+    t = Tracer()
+    with t.span("alpha"):
+        pass
+    t.save(tpath)
+    rc = report_main(["report", tpath])
+    out = capsys.readouterr().out
+    assert rc == 0 and "alpha" in out and "bench artifact" not in out
+
+
+def test_report_cluster_dir_renders_fleet_with_na_for_old_reports(
+    tmp_path, capsys,
+):
+    from tpu_swirld.obs.report import main as report_main
+
+    # node-0: a current-shape report; node-1: an old report missing the
+    # PR 16 keys (trace_events, finality) — must render n/a, not raise
+    with open(tmp_path / "node-0.report.json", "w") as f:
+        json.dump({
+            "node": "n0", "events": 10, "decided": ["aa"], "decided_tx": 4,
+            "unclean_start": False, "trace_events": 12, "trace_dropped": 0,
+            "finality": {"decided": 1, "rtd_p50": 3.0, "undecided": 2},
+            "counters": {"tx_accepted": 4, "tx_shed_pool": 1,
+                         "wal_torn_tail_recovered": 0,
+                         "node_circuit_opens": 0},
+        }, f)
+    with open(tmp_path / "node-1.report.json", "w") as f:
+        json.dump({"node": "n1", "events": 8, "decided": [],
+                   "counters": {}}, f)
+    with open(tmp_path / "metrics.json", "w") as f:
+        json.dump({"polls": 2, "nodes": {"n0": [], "n1": []},
+                   "rollup": {"tx_accepted": 4.0}}, f)
+    rc = report_main(["report", "--cluster-dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "cluster fleet (2 node reports)" in out
+    assert "n/a" in out                          # node-1's missing keys
+    assert "tx_accepted" in out and "polls=2" in out
+    assert "shed / backpressure" in out
+    assert "WAL recovery" in out
+    assert "circuit breaker / retries" in out
+    assert "merged cross-process trace" in out   # n/a pointer section
+    # an empty dir still renders (all n/a) and exits 0
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    rc = report_main(["report", "--cluster-dir", str(empty)])
+    out = capsys.readouterr().out
+    assert rc == 0 and "no node-*.report.json" in out
+
+
+# --------------------------------- telemetry plane: lint scope coverage
+
+
+def test_lint_scopes_cover_new_obs_modules():
+    """obs/cluster_trace.py and obs/profile.py sit inside the SW002 and
+    SW003 scopes; profile.py is additionally in the SW003 note scope, so
+    its single wall read must carry a justified suppression."""
+    from tpu_swirld.analysis.lint import check_source
+
+    set_iter = "def f(s):\n    for x in {1, 2}:\n        pass\n"
+    clock = "import time\n\ndef f():\n    return time.perf_counter(){}\n"
+    for mod in ("obs/cluster_trace.py", "obs/profile.py"):
+        assert any(
+            f.rule == "SW002"
+            for f in check_source(set_iter, module_path=mod, rules=["SW002"])
+        ), mod
+        assert any(
+            f.rule == "SW003"
+            for f in check_source(
+                clock.format(""), module_path=mod, rules=["SW003"],
+            )
+        ), mod
+    # note scope: a bare disable is NOT enough in profile.py...
+    assert check_source(
+        clock.format("   # swirld-lint: disable=SW003"),
+        module_path="obs/profile.py", rules=["SW003"],
+    )
+    # ...a justified one is
+    assert check_source(
+        clock.format("   # swirld-lint: disable=SW003 -- profiler callsite"),
+        module_path="obs/profile.py", rules=["SW003"],
+    ) == []
+    # and the shipped modules themselves pass the full rule set
+    import tpu_swirld.obs as obspkg
+    from tpu_swirld.analysis.lint import lint_paths
+
+    base = os.path.dirname(obspkg.__file__)
+    findings = lint_paths([
+        os.path.join(base, "cluster_trace.py"),
+        os.path.join(base, "profile.py"),
+    ])
+    assert findings == [], [str(f) for f in findings]

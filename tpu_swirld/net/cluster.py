@@ -23,6 +23,20 @@ submission→decided latency via
 post-mortem path (``flightrec_dump``, ``None`` for clean starts) — a
 red verdict ships its own forensics.
 
+Telemetry plane (PR 16): the supervisor is also the cluster's
+observability hub.  Every client submission opens a root span whose
+16-byte trace context (:func:`tpu_swirld.obs.tracer.pack_context`) rides
+the SUBMIT frame header, so one transaction's journey — client → TxPool
+→ gossip hops → decided — reassembles into a single causally-linked
+timeline via :func:`tpu_swirld.obs.cluster_trace.merge_dir` (written to
+``merged.trace.json`` in the workdir).  On an injected-clock cadence
+(``metrics_poll_s``) the supervisor polls every node's registry snapshot
+over ``KIND_METRICS`` frames and, post-run, writes ``metrics.json``
+(per-node samples + cluster rollup) and ``metrics.prom`` (merged
+Prometheus exposition).  The verdict carries both under ``trace`` /
+``metrics``; ``python -m tpu_swirld.obs report --cluster-dir`` renders
+the fleet view.
+
 ``scripts/cluster_run.py`` is the CLI wrapper; ``python bench.py
 --cluster`` benches the same harness.
 """
@@ -46,7 +60,10 @@ from tpu_swirld.config import SwirldConfig
 from tpu_swirld.net import frame
 from tpu_swirld.net.frame import allocate_ports
 from tpu_swirld.net.node_proc import derive_paths
+from tpu_swirld.obs import cluster_trace
 from tpu_swirld.obs.finality import merged_dist
+from tpu_swirld.obs.registry import merge_node_samples, rollup_node_samples
+from tpu_swirld.obs.tracer import Tracer, pack_context
 from tpu_swirld.oracle.event import Event, MalformedEvent, decode_event
 from tpu_swirld.sim import member_keys
 
@@ -72,6 +89,7 @@ class ClusterSpec:
     kill_at_s: Optional[float] = None
     restart_at_s: Optional[float] = None
     flightrec_dir: Optional[str] = None
+    metrics_poll_s: float = 1.0     # KIND_METRICS snapshot cadence (<=0 off)
     host: str = "127.0.0.1"
     ready_timeout_s: float = 30.0
     stop_timeout_s: float = 60.0
@@ -100,9 +118,12 @@ class ClusterClient:
 
     def call(
         self, i: int, kind: int, payload: bytes = b"",
+        trace: bytes = b"",
     ) -> Tuple[int, bytes]:
         """One request/reply exchange with node ``i``; raises ``OSError``
-        when the node is unreachable (e.g. inside the crash window)."""
+        when the node is unreachable (e.g. inside the crash window).
+        ``trace`` (16 bytes or empty) rides the frame header so the node
+        can parent its handling span under the client's."""
         for attempt in (0, 1):
             sock = self._conns.get(i)
             reused = sock is not None
@@ -114,7 +135,7 @@ class ClusterClient:
                 sock.settimeout(self.timeout)
                 self._conns[i] = sock
             try:
-                frame.send_request(sock, kind, b"", payload)
+                frame.send_request(sock, kind, b"", payload, trace=trace)
                 return frame.recv_reply(sock)
             except (ConnectionError, OSError):
                 self._drop(i)
@@ -169,6 +190,11 @@ class ClusterSupervisor:
         self.restarts: Dict[int, int] = {}
         self.client = ClusterClient(spec.host, self.ports)
         self._logs: List = []
+        # the supervisor's own trace shard: pid 1000 keeps its span ids
+        # (pid folded into the upper bits) clear of any node index
+        self.tracer = Tracer(pid=1000)
+        self.metrics_samples: Dict[str, List[Dict]] = {}
+        self.metrics_polls = 0
 
     # ----------------------------------------------------------- processes
 
@@ -255,6 +281,74 @@ class ClusterSupervisor:
         self.wait_ready([i])
         self.restarts[i] = self.restarts.get(i, 0) + 1
 
+    # ----------------------------------------------------------- telemetry
+
+    def poll_metrics(self) -> int:
+        """One metrics sweep: ask every live node for its registry
+        snapshot (``KIND_METRICS``).  Unreachable nodes (crash window)
+        are skipped — the latest snapshot per node label wins, so a
+        restarted node overwrites its pre-crash sample set.  Returns the
+        number of nodes that answered."""
+        answered = 0
+        for i in range(self.spec.n_nodes):
+            proc = self.procs.get(i)
+            if proc is None or proc.poll() is not None:
+                continue
+            try:
+                _status, reply = self.client.call(i, frame.KIND_METRICS)
+                snap = json.loads(reply.decode())
+            except (OSError, ValueError):
+                continue
+            self.metrics_samples[snap.get("node", f"n{i}")] = \
+                snap.get("samples", [])
+            answered += 1
+        if answered:
+            self.metrics_polls += 1
+        return answered
+
+    def write_telemetry(self) -> Tuple[Dict, Dict]:
+        """Post-run telemetry artifacts in the workdir:
+
+        - ``client.trace.jsonl`` — the supervisor's trace shard;
+        - ``merged.trace.json`` — all shards merged onto one timebase
+          with cross-process flow arrows (Perfetto-openable);
+        - ``metrics.json`` — per-node registry samples + cluster rollup;
+        - ``metrics.prom`` — merged Prometheus exposition (``node``
+          label per sample).
+
+        Returns the verdict's ``(trace, metrics)`` sections."""
+        wd = self.spec.workdir
+        self.tracer.save(os.path.join(wd, "client.trace.jsonl"))
+        merged_path = os.path.join(wd, "merged.trace.json")
+        merged = cluster_trace.merge_dir(wd, out_path=merged_path)
+        trace_section = {
+            "merged": merged_path,
+            "shards": len(merged["shards"]),
+            "events": merged["events"],
+            "traces": merged["traces"],
+            "cross_process_traces": merged["cross_process_traces"],
+            "cross_process_trace_ids": merged["cross_process_trace_ids"],
+        }
+        metrics_json = os.path.join(wd, "metrics.json")
+        metrics_prom = os.path.join(wd, "metrics.prom")
+        rollup = rollup_node_samples(self.metrics_samples)
+        with open(metrics_json, "w") as f:
+            json.dump({
+                "polls": self.metrics_polls,
+                "nodes": self.metrics_samples,
+                "rollup": rollup,
+            }, f, indent=2, sort_keys=True)
+        with open(metrics_prom, "w") as f:
+            f.write(merge_node_samples(self.metrics_samples)
+                    .to_prometheus_text())
+        metrics_section = {
+            "json": metrics_json,
+            "prom": metrics_prom,
+            "polls": self.metrics_polls,
+            "nodes_covered": len(self.metrics_samples),
+        }
+        return trace_section, metrics_section
+
     def stop_all(self) -> None:
         for i, proc in self.procs.items():
             if proc.poll() is None:
@@ -299,10 +393,15 @@ def run_cluster(spec: ClusterSpec) -> Dict:
         t0 = frame.now()
         t_end = t0 + spec.duration_s
         gap = 1.0 / spec.tx_rate if spec.tx_rate > 0 else None
+        poll_gap = spec.metrics_poll_s if spec.metrics_poll_s > 0 else None
         next_submit = t0
+        next_poll = t0 + (poll_gap or 0.0)
         k = 0
         while frame.now() < t_end:
             now = frame.now()
+            if poll_gap is not None and now >= next_poll:
+                next_poll += poll_gap
+                sup.poll_metrics()
             if (
                 not killed
                 and spec.kill_index is not None
@@ -332,26 +431,50 @@ def run_cluster(spec: ClusterSpec) -> Dict:
                 payload = (b"tx-%08d:" % k).ljust(spec.tx_bytes, b"x")
                 k += 1
                 tx["submitted"] += 1
-                try:
-                    _status, reply = sup.client.call(
-                        target, frame.KIND_SUBMIT, payload,
-                    )
-                except OSError:
-                    tx["failed"] += 1   # crash window: expected
-                    continue
-                if reply.startswith(b"ACK:"):
-                    tx["acked"] += 1
-                elif reply.startswith(b"DUP:"):
-                    tx["duplicate"] += 1
-                else:
-                    tx["shed"] += 1
+                # root of the transaction's trace: trace id = first 8
+                # bytes of the tx id, parent 0 — the node's handling
+                # span (and every gossip hop after it) parents under
+                # this via the frame header's 16-byte context
+                ctx = pack_context(crypto.hash_bytes(payload)[:8], 0)
+                with sup.tracer.span_under(
+                    "client.submit", ctx, node=target,
+                ) as sp:
+                    try:
+                        _status, reply = sup.client.call(
+                            target, frame.KIND_SUBMIT, payload,
+                            trace=sup.tracer.active_context() or b"",
+                        )
+                    except OSError:
+                        tx["failed"] += 1   # crash window: expected
+                        sp.args["outcome"] = "failed"
+                        continue
+                    if reply.startswith(b"ACK:"):
+                        tx["acked"] += 1
+                        sp.args["outcome"] = "acked"
+                    elif reply.startswith(b"DUP:"):
+                        tx["duplicate"] += 1
+                        sp.args["outcome"] = "duplicate"
+                    else:
+                        tx["shed"] += 1
+                        sp.args["outcome"] = "shed"
             frame.sleep(min(0.002, gap or 0.002))
+        # closing sweep with every node up: the rollup covers the fleet
+        if poll_gap is not None:
+            sup.poll_metrics()
     finally:
         sup.stop_all()
+    # node trace shards land on clean shutdown — merge after stop_all
+    try:
+        trace_section, metrics_section = sup.write_telemetry()
+    except (OSError, ValueError) as e:   # torn shard from a crash window
+        trace_section = {"error": str(e)}
+        metrics_section = {"error": str(e), "polls": sup.metrics_polls,
+                           "nodes_covered": len(sup.metrics_samples)}
     return _verdict(
         spec, sup, tx,
         killed=killed, restarted=restarted,
         decided_at_heal=decided_at_heal, heal_wall_s=heal_wall_s,
+        trace_section=trace_section, metrics_section=metrics_section,
     )
 
 
@@ -363,6 +486,8 @@ def _verdict(
     restarted: bool,
     decided_at_heal: Optional[int],
     heal_wall_s: Optional[float],
+    trace_section: Optional[Dict] = None,
+    metrics_section: Optional[Dict] = None,
 ) -> Dict:
     """Assemble the safety/liveness verdict from the per-node reports
     and event logs left on disk."""
@@ -463,4 +588,6 @@ def _verdict(
         "counters": shed_counters,
         "nodes": nodes,
         "reports": len(reports),
+        "trace": trace_section or {},
+        "metrics": metrics_section or {},
     }

@@ -2,11 +2,20 @@
 
 Wire format (all integers little-endian):
 
-- request:  ``<I nbytes> <B kind> <H len(src_pk)> src_pk payload``
+- request:  ``<I nbytes> <B kind> <H len(src_pk)> src_pk [trace] payload``
 - reply:    ``<I nbytes> <B status> payload``
 
 ``nbytes`` counts everything after the length prefix, so one
 ``recv_exact(4)`` + ``recv_exact(nbytes)`` pair reads a whole frame.
+
+Trace context (version-gated): when the high bit of the kind byte
+(:data:`TRACE_FLAG`) is set, a fixed 16-byte trace context (8-byte trace
+id + u64 parent span id, see :mod:`tpu_swirld.obs.tracer`) sits between
+``src_pk`` and the payload.  Untraced frames are byte-identical to the
+pre-trace wire format, so an old sender interoperates with a new
+receiver unchanged; a traced frame hitting an *old* receiver decodes to
+an unknown kind (e.g. ``0x81``) and is rejected by the dispatch layer's
+documented unknown-kind path — a clean REJECT, never a misparse.
 Both directions are bounds-checked against a max-frame knob before any
 allocation, so a garbage length prefix from a byzantine peer cannot make
 the receiver allocate gigabytes (:class:`FrameError` — an ``OSError``
@@ -42,6 +51,14 @@ KIND_SUBMIT = 3     # client transaction submission (payload = raw tx)
 KIND_STATUS = 4     # JSON status probe (supervisor liveness/watermarks)
 KIND_STOP = 5       # graceful shutdown request
 KIND_PING = 6       # readiness probe
+KIND_METRICS = 7    # registry snapshot poll (supervisor metrics plane)
+
+#: kind-byte high bit: a 16-byte trace context follows src_pk
+TRACE_FLAG = 0x80
+KIND_MASK = 0x7F
+
+#: wire size of the optional trace context (mirrors obs.tracer)
+TRACE_CTX_LEN = 16
 
 #: reply status
 STATUS_OK = 0       # payload = endpoint reply bytes
@@ -87,25 +104,48 @@ def recv_exact(sock: socket.socket, n: int) -> bytes:
 
 def send_request(
     sock: socket.socket, kind: int, src: bytes, payload: bytes,
+    trace: bytes = b"",
 ) -> None:
-    body = _REQ_HEAD.pack(kind, len(src)) + src + payload
+    """Send one request frame; a non-empty ``trace`` (exactly
+    :data:`TRACE_CTX_LEN` bytes) sets :data:`TRACE_FLAG` on the kind
+    byte and rides between ``src`` and ``payload``."""
+    if trace:
+        if len(trace) != TRACE_CTX_LEN:
+            raise ValueError(
+                f"trace context must be {TRACE_CTX_LEN} bytes, "
+                f"got {len(trace)}"
+            )
+        body = (_REQ_HEAD.pack(kind | TRACE_FLAG, len(src))
+                + src + trace + payload)
+    else:
+        body = _REQ_HEAD.pack(kind, len(src)) + src + payload
     sock.sendall(_LEN.pack(len(body)) + body)
 
 
 def recv_request(
     sock: socket.socket, max_frame: int = MAX_FRAME_BYTES,
-) -> Tuple[int, bytes, bytes]:
-    """Returns ``(kind, src_pk, payload)``; raises on EOF / bad frame."""
+) -> Tuple[int, bytes, bytes, bytes]:
+    """Returns ``(kind, src_pk, payload, trace)`` where ``trace`` is the
+    16-byte context for flagged frames, else ``b""``; raises on EOF /
+    bad frame."""
     (nbytes,) = _LEN.unpack(recv_exact(sock, 4))
     if nbytes < _REQ_HEAD.size or nbytes > max_frame:
         raise FrameError(f"bad request frame length {nbytes}")
     body = recv_exact(sock, nbytes)
-    kind, src_len = _REQ_HEAD.unpack_from(body)
-    if _REQ_HEAD.size + src_len > len(body):
+    kind_raw, src_len = _REQ_HEAD.unpack_from(body)
+    kind = kind_raw & KIND_MASK
+    off = _REQ_HEAD.size + src_len
+    if off > len(body):
         raise FrameError(f"request src overruns frame ({src_len} bytes)")
-    src = body[_REQ_HEAD.size:_REQ_HEAD.size + src_len]
-    payload = body[_REQ_HEAD.size + src_len:]
-    return kind, src, payload
+    src = body[_REQ_HEAD.size:off]
+    trace = b""
+    if kind_raw & TRACE_FLAG:
+        if off + TRACE_CTX_LEN > len(body):
+            raise FrameError("traced request missing its 16-byte context")
+        trace = body[off:off + TRACE_CTX_LEN]
+        off += TRACE_CTX_LEN
+    payload = body[off:]
+    return kind, src, payload, trace
 
 
 def send_reply(sock: socket.socket, status: int, payload: bytes) -> None:

@@ -35,6 +35,7 @@ node process starts in milliseconds and never touches an accelerator.
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import random
@@ -51,11 +52,18 @@ from tpu_swirld.net.transport import SocketTransport
 from tpu_swirld.net.wal import OwnEventWal
 from tpu_swirld.obs.finality import FinalityTracker
 from tpu_swirld.obs.flightrec import FlightRecorder
+from tpu_swirld.obs.registry import Registry
+from tpu_swirld.obs.tracer import Tracer
 from tpu_swirld.oracle.event import encode_event
 from tpu_swirld.oracle.node import Node
 from tpu_swirld.sim import member_keys
 
-REPORT_VERSION = 1
+REPORT_VERSION = 2
+
+#: trace-shard memory bounds: spans kept per node process, and how many
+#: submitted-tx trace contexts are remembered for decided correlation
+TRACE_MAX_EVENTS = 200_000
+TX_TRACE_CAP = 4096
 
 
 def derive_paths(workdir: str, index: int) -> Dict[str, str]:
@@ -68,6 +76,7 @@ def derive_paths(workdir: str, index: int) -> Dict[str, str]:
         "report": stem + ".report.json",
         "events": stem + ".events.bin",
         "ready": stem + ".ready",
+        "trace": stem + ".trace.jsonl",
     }
 
 
@@ -153,11 +162,11 @@ class NodeServer:
         try:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             while True:
-                kind, src, payload = frame.recv_request(
+                kind, src, payload, trace = frame.recv_request(
                     conn, self._max_frame,
                 )
                 try:
-                    status, reply = self._dispatch(kind, src, payload)
+                    status, reply = self._dispatch(kind, src, payload, trace)
                 except ValueError as e:
                     # the endpoints' documented rejection plane: counted
                     # by the caller as a bad reply, never retried
@@ -219,6 +228,22 @@ class NodeRuntime:
         self.lock = threading.Lock()
         self.stop = threading.Event()
 
+        # --- telemetry: per-process trace shard + metrics registry --------
+        # All tracer/registry mutation happens under self.lock (dispatch
+        # and the gossip turn both hold it), so server threads and the
+        # loop interleave spans without torn state.
+        self.tracer = Tracer(pid=self.index, max_events=TRACE_MAX_EVENTS)
+        self.registry = Registry()
+        #: txid -> wire trace context for txs submitted to THIS node,
+        #: so the decided marker closes the trace where it began
+        self._tx_traces: "collections.OrderedDict[bytes, bytes]" = (
+            collections.OrderedDict()
+        )
+        #: context stamped onto outgoing gossip frames for the *current*
+        #: traced turn (read lock-free by the transport while the lock is
+        #: yielded around socket I/O — a bytes snapshot, not stack state)
+        self._gossip_ctx = b""
+
         # --- durability: WAL scan + startup post-mortem -------------------
         self.wal = OwnEventWal(self.paths["wal"], pk=self.pk)
         self.unclean_start = self.wal.unclean
@@ -226,6 +251,8 @@ class NodeRuntime:
             dump_dir=spec.get("flightrec_dir"),
             wall_clock=frame.now,
             config=self.config,
+            node_name=self.label,
+            trace_provider=self.tracer.active_trace_hex,
         )
         self.flightrec_dump = startup_postmortem(
             self.wal, self.flightrec, self.label,
@@ -233,6 +260,7 @@ class NodeRuntime:
 
         # --- transport + node (checkpoint restore when one exists) -------
         sock_transport = SocketTransport(settings=self.settings, src=self.pk)
+        sock_transport.trace_provider = lambda: self._gossip_ctx
         for j, pk_j in enumerate(self.members):
             if j != self.index:
                 sock_transport.register(pk_j, self.host, self.ports[j])
@@ -284,7 +312,9 @@ class NodeRuntime:
             max_undecided=self.settings["max_undecided"],
             window_fn=lambda: self.node.undecided_window,
         )
-        self.tracker = FinalityTracker("cluster", clock=frame.now)
+        self.tracker = FinalityTracker(
+            "cluster", clock=frame.now, registry=self.registry,
+        )
         self.decided_txids: set = set()
         self.decided_tx = 0
         self._decided_watermark = 0
@@ -299,8 +329,10 @@ class NodeRuntime:
     # ------------------------------------------------------------ dispatch
 
     def dispatch(self, kind: int, src: bytes, payload: bytes,
-                 ) -> Tuple[int, bytes]:
-        """Serve one framed request (called from server threads)."""
+                 trace: bytes = b"") -> Tuple[int, bytes]:
+        """Serve one framed request (called from server threads); a
+        non-empty ``trace`` is the sender's 16-byte span context — the
+        handler's span becomes its cross-process child."""
         if kind == frame.KIND_PING:
             return frame.STATUS_OK, b"pong"
         if kind == frame.KIND_STOP:
@@ -308,21 +340,55 @@ class NodeRuntime:
             return frame.STATUS_OK, b"stopping"
         if kind == frame.KIND_SUBMIT:
             with self.lock:
-                accepted, reply = self.pool.submit(payload)
+                with self.tracer.span_under("node.submit", trace) as sp:
+                    accepted, reply = self.pool.submit(payload)
+                    sp.args["outcome"] = (
+                        reply.split(b":", 1)[0].decode("ascii", "replace")
+                    )
+                    # remember THIS span's context: the gossip turn that
+                    # drains the tx parents under it, extending the trace
+                    own_ctx = self.tracer.active_context()
                 if accepted:
-                    self.tracker.mark_birth(crypto.hash_bytes(payload))
+                    txid = crypto.hash_bytes(payload)
+                    self.tracker.mark_birth(txid)
+                    if own_ctx:
+                        self._remember_trace(txid, own_ctx)
             return frame.STATUS_OK, reply
         if kind == frame.KIND_STATUS:
             with self.lock:
                 body = json.dumps(self.status()).encode()
             return frame.STATUS_OK, body
+        if kind == frame.KIND_METRICS:
+            with self.lock:
+                body = json.dumps(self.metrics_snapshot()).encode()
+            return frame.STATUS_OK, body
         if kind == frame.KIND_SYNC:
             with self.lock:
+                if trace:
+                    with self.tracer.span_under(
+                        "node.serve_sync", trace,
+                    ) as sp:
+                        reply = self.node.ask_sync(src, payload)
+                        sp.args["reply_bytes"] = len(reply)
+                    return frame.STATUS_OK, reply
                 return frame.STATUS_OK, self.node.ask_sync(src, payload)
         if kind == frame.KIND_WANT:
             with self.lock:
+                if trace:
+                    with self.tracer.span_under(
+                        "node.serve_want", trace,
+                    ) as sp:
+                        reply = self.node.ask_events(src, payload)
+                        sp.args["reply_bytes"] = len(reply)
+                    return frame.STATUS_OK, reply
                 return frame.STATUS_OK, self.node.ask_events(src, payload)
         raise ValueError(f"unknown request kind {kind}")
+
+    def _remember_trace(self, txid: bytes, trace: bytes) -> None:
+        """Bounded txid -> submit-context map (oldest evicted first)."""
+        self._tx_traces[txid] = trace
+        while len(self._tx_traces) > TX_TRACE_CAP:
+            self._tx_traces.popitem(last=False)
 
     # -------------------------------------------------------------- status
 
@@ -340,6 +406,36 @@ class NodeRuntime:
             "recovering": self._recovering(),
             "unclean_start": self.unclean_start,
             "flightrec_dump": self.flightrec_dump,
+        }
+
+    def metrics_snapshot(self) -> Dict:
+        """Registry snapshot body for :data:`frame.KIND_METRICS` (caller
+        holds the lock): the live counters from pool / transport / node
+        are synced into the registry as gauges first, so the supervisor
+        sees one structured sample stream per node."""
+        reg = self.registry
+        node = self.node
+        for k in sorted(self.pool.counters):
+            reg.gauge(k).set(self.pool.counters[k])
+        for k in sorted(self.transport.stats):
+            reg.gauge(f"net_{k}").set(self.transport.stats[k])
+        reg.gauge("node_retries").set(node.retries)
+        reg.gauge("node_bad_replies").set(node.bad_replies)
+        reg.gauge("node_bad_requests").set(node.bad_requests)
+        reg.gauge("node_circuit_opens").set(node.circuit_opens)
+        reg.gauge("hg_events").set(len(node.hg))
+        reg.gauge("decided_events").set(len(node.consensus))
+        reg.gauge("decided_tx").set(self.decided_tx)
+        reg.gauge("pending_txs").set(len(self.pool.pending))
+        reg.gauge("undecided_window").set(node.undecided_window)
+        reg.gauge("wal_torn_tail_recovered").set(
+            self.wal.torn_tail_recovered
+        )
+        reg.gauge("trace_events").set(len(self.tracer.events))
+        return {
+            "node": self.label,
+            "index": self.index,
+            "samples": reg.to_samples(),
         }
 
     def _recovering(self) -> bool:
@@ -369,15 +465,44 @@ class NodeRuntime:
             batch = (
                 self.pool.next_batch() if node.member_events[peer] else b""
             )
-            prev_head = node.head
-            new_ids = node.sync(peer, batch)
-            if node.head != prev_head:
-                # durable BEFORE any peer can observe it: the lock is
-                # held until after this fsync completes
-                self.wal.append(node.hg[node.head])
-            if new_ids:
-                node.consensus_pass(new_ids)
+            ctx = self._batch_trace(batch)
+            if ctx:
+                with self.tracer.span_under("gossip.sync", ctx) as sp:
+                    sp.args["peer"] = peer[:4].hex()
+                    sp.args["batch_bytes"] = len(batch)
+                    # snapshot for the transport to stamp onto the
+                    # outgoing frames of this turn (read without lock)
+                    self._gossip_ctx = self.tracer.active_context() or b""
+                    try:
+                        self._sync_step(peer, batch)
+                    finally:
+                        self._gossip_ctx = b""
+            else:
+                self._sync_step(peer, batch)
         self._record_decided()
+
+    def _sync_step(self, peer: bytes, batch: bytes) -> None:
+        """The durable sync body (caller holds the lock)."""
+        node = self.node
+        prev_head = node.head
+        new_ids = node.sync(peer, batch)
+        if node.head != prev_head:
+            # durable BEFORE any peer can observe it: the lock is
+            # held until after this fsync completes
+            self.wal.append(node.hg[node.head])
+        if new_ids:
+            node.consensus_pass(new_ids)
+
+    def _batch_trace(self, batch: bytes) -> bytes:
+        """Submit-span context of the first traced tx in ``batch`` (the
+        turn that first gossips a traced submission joins its trace)."""
+        if not batch or not self._tx_traces:
+            return b""
+        for tx in decode_batch(batch):
+            ctx = self._tx_traces.get(crypto.hash_bytes(tx))
+            if ctx:
+                return ctx
+        return b""
 
     def _record_decided(self) -> None:
         """Walk newly decided events; record each decided transaction's
@@ -400,6 +525,14 @@ class NodeRuntime:
                     node.round_received.get(eid, 0),
                     now=t,
                 )
+                ctx = self._tx_traces.pop(txid, None)
+                if ctx is not None:
+                    # zero-length marker span closing the trace on the
+                    # node that accepted the submission
+                    with self.tracer.span_under("tx.decided", ctx) as sp:
+                        sp.args["round_received"] = (
+                            node.round_received.get(eid, 0)
+                        )
 
     def _checkpoint(self) -> None:
         """Atomic checkpoint + WAL prune (caller holds the lock): after
@@ -443,9 +576,16 @@ class NodeRuntime:
             self._record_decided()
             self._checkpoint()
             self._write_report()
+            self._write_trace()
             self.wal.mark_clean()
         self.transport.close()
         return 0
+
+    def _write_trace(self) -> None:
+        """Per-process Chrome-trace JSONL shard (caller holds the lock);
+        ``obs/cluster_trace.py`` merges one per node + the supervisor's
+        client shard into the cluster timeline."""
+        self.tracer.save(self.paths["trace"])
 
     # -------------------------------------------------------------- report
 
@@ -462,9 +602,13 @@ class NodeRuntime:
         counters["node_circuit_opens"] = node.circuit_opens
         report = {
             "report_version": REPORT_VERSION,
+            "node": self.label,
             "index": self.index,
             "pk": self.pk.hex(),
             "seed": self.seed,
+            "trace": self.paths["trace"],
+            "trace_events": len(self.tracer.events),
+            "trace_dropped": self.tracer.dropped,
             "restored": self.restored,
             "unclean_start": self.unclean_start,
             "flightrec_dump": self.flightrec_dump,

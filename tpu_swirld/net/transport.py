@@ -74,6 +74,22 @@ class SocketTransport(Transport):
         self.src = src
         self._conns: Dict[bytes, socket.socket] = {}
         self.stats: Dict[str, int] = collections.defaultdict(int)
+        #: zero-arg callable -> 16-byte trace context (or None/b"") used
+        #: to stamp outgoing frames.  Defaults to the ambient obs
+        #: tracer's innermost traced span; node_proc overrides it with a
+        #: lock-safe snapshot because its server threads share the
+        #: tracer with the gossip loop.
+        self.trace_provider = None
+
+    def _trace_ctx(self) -> bytes:
+        if self.trace_provider is not None:
+            return self.trace_provider() or b""
+        o = obs.current()
+        if o is not None:
+            ctx = o.tracer.active_context()
+            if ctx:
+                return ctx
+        return b""
 
     # ------------------------------------------------------------ plumbing
 
@@ -137,6 +153,7 @@ class SocketTransport(Transport):
             raise PeerUnreachable(f"no address for peer on {channel}")
         kind = _CHANNEL_KIND.get(channel, frame.KIND_WANT)
         max_frame = self.settings["max_frame_bytes"]
+        trace = self._trace_ctx()
         # one transparent redial: a cached connection may have died
         # (server restart, idle reset) — that is not a peer failure yet
         for attempt in (0, 1):
@@ -145,7 +162,9 @@ class SocketTransport(Transport):
             if sock is None:
                 sock = self._connect(dst, addr)
             try:
-                frame.send_request(sock, kind, src or self.src, payload)
+                frame.send_request(
+                    sock, kind, src or self.src, payload, trace=trace,
+                )
                 status, reply = frame.recv_reply(sock, max_frame)
             except socket.timeout as e:
                 # drop the connection: a stale reply surfacing on the

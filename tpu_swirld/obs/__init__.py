@@ -64,6 +64,7 @@ from tpu_swirld.obs.flightrec import (  # noqa: F401
 from tpu_swirld.obs.memory import (  # noqa: F401
     MemoryMonitor, device_live_bytes,
 )
+from tpu_swirld.obs.profile import DispatchProfiler  # noqa: F401
 from tpu_swirld.obs.registry import (  # noqa: F401
     Counter, Gauge, Histogram, Registry,
 )
@@ -73,15 +74,19 @@ from tpu_swirld.obs.tracer import (  # noqa: F401
 
 
 class Obs:
-    """A tracer + registry bundle — the unit ``enable()`` installs."""
+    """A tracer + registry bundle — the unit ``enable()`` installs.
+    An optional :class:`~tpu_swirld.obs.profile.DispatchProfiler` rides
+    along; when present, every :func:`stage_call` feeds it."""
 
     def __init__(
         self,
         tracer: Optional[Tracer] = None,
         registry: Optional[Registry] = None,
+        profiler: Optional[DispatchProfiler] = None,
     ):
         self.tracer = tracer if tracer is not None else Tracer()
         self.registry = registry if registry is not None else Registry()
+        self.profiler = profiler
 
     def save(self, path: str) -> None:
         """Write the trace plus the registry snapshot (as Chrome counter
@@ -210,7 +215,8 @@ def stage_call(name: str, fn, *args, **kw):
     with o.tracer.span(name) as sp:
         out = fn(*args, **kw)
         out = jax.block_until_ready(out)
-        dt = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        dt = t1 - t0
         kind = "execute"
         if c0 >= 0 and _jit_cache_size(fn) > c0:
             kind = "compile"
@@ -218,7 +224,25 @@ def stage_call(name: str, fn, *args, **kw):
     reg = o.registry
     reg.counter("pipeline_stage_seconds", {"stage": name, "kind": kind}).inc(dt)
     reg.counter("pipeline_stage_calls", {"stage": name, "kind": kind}).inc()
+    if o.profiler is not None and kind == "execute":
+        # compiles are one-time cost, not steady-state dispatch overhead
+        o.profiler.record_dispatch(name, t0, t1, args=args)
     return out
+
+
+def to_host(x, copy: bool = False):
+    """Pull a (device) array to host numpy, counting the D2H bytes into
+    the ambient dispatch profiler — the driver's pull sites route
+    through here so ``transfers_bytes.d2h`` reflects every round-trip.
+    ``copy=True`` forces a mutable owned copy (``np.array`` semantics
+    for mirrors mutated in place)."""
+    import numpy as _np
+
+    arr = _np.array(x) if copy else _np.asarray(x)
+    o = current()
+    if o is not None and o.profiler is not None:
+        o.profiler.record_transfer("d2h", arr.nbytes)
+    return arr
 
 
 def _jit_cache_size(fn) -> int:

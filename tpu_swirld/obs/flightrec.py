@@ -72,6 +72,14 @@ class FlightRecorder:
         dumps; **leave None in simulations** so dumps stay byte-stable.
       config: optional :class:`~tpu_swirld.config.SwirldConfig` — both
         the knob source and the config echoed into dumps.
+      node_name: process identity stamped into every dump so shards
+        from different node processes correlate against the merged
+        cluster timeline.
+      trace_provider: optional zero-arg callable returning the hex id of
+        the currently active trace (e.g. ``Tracer.active_trace_hex``) —
+        dumps record which cross-process trace was in flight when the
+        trigger fired.  Stays ``None`` in simulations (byte-stable
+        dumps).
     """
 
     def __init__(
@@ -82,6 +90,8 @@ class FlightRecorder:
         clock: Optional[Callable[[], float]] = None,
         wall_clock: Optional[Callable[[], float]] = None,
         config=None,
+        node_name: Optional[str] = None,
+        trace_provider: Optional[Callable[[], Optional[str]]] = None,
     ):
         s = resolve_flightrec_settings(config)
         self.capacity = int(capacity if capacity is not None
@@ -92,6 +102,8 @@ class FlightRecorder:
         self._clock = clock
         self._wall = wall_clock
         self._config = config
+        self.node_name = node_name
+        self._trace_provider = trace_provider
         self._rings: Dict[str, collections.deque] = {}
         self.records_total = 0
         self.trigger_counts: Dict[str, int] = {}
@@ -190,6 +202,11 @@ class FlightRecorder:
             "schema": SCHEMA,
             "reason": str(reason),
             "seq": self._seq,
+            "node_name": self.node_name,
+            "trace_id": (
+                self._trace_provider()
+                if self._trace_provider is not None else None
+            ),
             "logical_tick": self._tick(),
             "wall_time_s": self._wall() if self._wall is not None else None,
             "capacity": self.capacity,

@@ -1,0 +1,187 @@
+"""Dispatch-level hot-path profiler over the ``obs.stage_call`` seam.
+
+ROADMAP item 4 attributes the streaming-vs-batch gap to "per-chunk
+dispatch and host-device round-trips" — this module measures instead of
+guesses.  Attach a :class:`DispatchProfiler` to the ambient
+:class:`~tpu_swirld.obs.Obs` and every ``stage_call`` dispatch feeds it:
+
+- *per-dispatch device/wall time* — ``stage_call`` already blocks on the
+  result, so span duration is dispatch + device completion;
+- *args-ready→dispatch latency* — the host-side gap between one
+  dispatch finishing and the next starting (Python driver overhead,
+  host work, transfer stalls) — the part a fused batch pipeline never
+  pays;
+- *host↔device transfer bytes* — numpy (host) arguments entering a
+  stage count as H2D; driver pulls through :func:`tpu_swirld.obs.
+  to_host` count as D2H.
+
+Chunk accounting: drivers bracket each ingest with :meth:`begin_chunk`
+/ :meth:`end_chunk`; the difference between a chunk's wall time and the
+sum of its stage times is ``dispatch_overhead_s`` — exactly the
+non-device cost the streaming engine pays per chunk.  :meth:`summary`
+emits the per-chunk breakdown plus a ranked top-k stage cost list;
+``bench.py --stream`` publishes it (and ``scripts/bench_compare.py``
+gates ``stream.dispatch_overhead_s`` lower-is-better).
+
+Clock discipline (SW003): this module reads wall time at exactly ONE
+callsite (:func:`_wall`, behind a justified suppression); tests may
+inject a fake clock for determinism.  ``record_dispatch`` timestamps
+arrive from the caller and are merely subtracted.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+
+def _wall() -> float:
+    """The profiler's single wall read (monotonic seconds)."""
+    return time.perf_counter()   # swirld-lint: disable=SW003 -- the dispatch profiler's one timing callsite: measuring real host/device wall cost is its entire purpose; it observes, never steers, consensus
+
+#: ranked stage list length in summaries
+DEFAULT_TOP_K = 3
+
+
+def _host_arg_bytes(args) -> int:
+    """Bytes of *host* (numpy) array arguments — the H2D upload a
+    dispatch implies.  Device-resident arrays (jax.Array) don't count."""
+    total = 0
+    for a in args:
+        if isinstance(a, np.ndarray):
+            total += a.nbytes
+    return total
+
+
+class DispatchProfiler:
+    """Accumulates per-dispatch and per-chunk cost for one run.
+
+    Args:
+      top_k: length of the ranked stage list in :meth:`summary`.
+      clock: zero-arg monotonic-seconds callable for chunk walls
+        (injectable for tests); defaults to the module's single wall
+        read.  Must share a timebase with the timestamps handed to
+        :meth:`record_dispatch` (``stage_call`` uses ``perf_counter``).
+    """
+
+    def __init__(self, top_k: int = DEFAULT_TOP_K,
+                 clock: Optional[Callable[[], float]] = None):
+        self.top_k = int(top_k)
+        self._clock = clock if clock is not None else _wall
+        self._stage_s: Dict[str, float] = {}
+        self._stage_calls: Dict[str, int] = {}
+        self.dispatches = 0
+        self.stage_s_total = 0.0
+        self.gap_s_total = 0.0
+        self.h2d_bytes = 0
+        self.d2h_bytes = 0
+        self.chunks: List[Dict] = []
+        self._last_end: Optional[float] = None
+        self._chunk: Optional[Dict] = None
+
+    # --------------------------------------------------------- chunk marks
+
+    def begin_chunk(self, label: Optional[str] = None) -> None:
+        """Open a chunk scope (one streaming/incremental ingest)."""
+        self._chunk = {
+            "label": label if label is not None else len(self.chunks),
+            "t0": self._clock(),
+            "stage_s": 0.0, "dispatches": 0, "gap_s": 0.0,
+            "h2d_bytes": 0, "d2h_bytes": 0,
+        }
+        # gaps never span a chunk boundary: the wait between chunks is
+        # the caller's (data generation), not dispatch overhead
+        self._last_end = None
+
+    def end_chunk(self, n_events: int = 0) -> Optional[Dict]:
+        """Close the open chunk; returns its breakdown row."""
+        c = self._chunk
+        if c is None:
+            return None
+        self._chunk = None
+        wall = self._clock() - c.pop("t0")
+        c["wall_s"] = round(wall, 6)
+        c["overhead_s"] = round(max(0.0, wall - c["stage_s"]), 6)
+        c["stage_s"] = round(c["stage_s"], 6)
+        c["gap_s"] = round(c["gap_s"], 6)
+        c["n_events"] = int(n_events)
+        self.chunks.append(c)
+        self._last_end = None
+        return c
+
+    # ----------------------------------------------------------- recording
+
+    def record_dispatch(self, stage: str, t0: float, t1: float,
+                        args=()) -> None:
+        """One ``stage_call`` completed: ``t0``/``t1`` are its start/end
+        on the caller's monotonic clock; ``args`` are the stage's
+        positional arguments (scanned for host arrays — H2D bytes)."""
+        dt = max(0.0, t1 - t0)
+        self.dispatches += 1
+        self.stage_s_total += dt
+        self._stage_s[stage] = self._stage_s.get(stage, 0.0) + dt
+        self._stage_calls[stage] = self._stage_calls.get(stage, 0) + 1
+        gap = 0.0
+        if self._last_end is not None:
+            gap = max(0.0, t0 - self._last_end)
+            self.gap_s_total += gap
+        self._last_end = t1
+        h2d = _host_arg_bytes(args)
+        self.h2d_bytes += h2d
+        c = self._chunk
+        if c is not None:
+            c["stage_s"] += dt
+            c["dispatches"] += 1
+            c["gap_s"] += gap
+            c["h2d_bytes"] += h2d
+
+    def record_transfer(self, direction: str, nbytes: int) -> None:
+        """An explicit host↔device copy outside dispatch args
+        (``direction`` is ``"d2h"`` or ``"h2d"``)."""
+        nbytes = int(nbytes)
+        if direction == "d2h":
+            self.d2h_bytes += nbytes
+            if self._chunk is not None:
+                self._chunk["d2h_bytes"] += nbytes
+        else:
+            self.h2d_bytes += nbytes
+            if self._chunk is not None:
+                self._chunk["h2d_bytes"] += nbytes
+
+    # ------------------------------------------------------------- queries
+
+    def top_stages(self, k: Optional[int] = None) -> List[Dict]:
+        """Stages ranked by total seconds (descending; name breaks
+        ties deterministically)."""
+        k = self.top_k if k is None else int(k)
+        ranked = sorted(
+            self._stage_s, key=lambda s: (-self._stage_s[s], s),
+        )
+        return [
+            {
+                "stage": s,
+                "seconds": round(self._stage_s[s], 6),
+                "calls": self._stage_calls.get(s, 0),
+            }
+            for s in ranked[:k]
+        ]
+
+    def summary(self) -> Dict:
+        """The ``bench.py --stream`` dispatch-breakdown object."""
+        wall = sum(c["wall_s"] for c in self.chunks)
+        overhead = sum(c["overhead_s"] for c in self.chunks)
+        return {
+            "chunks": len(self.chunks),
+            "dispatches": self.dispatches,
+            "wall_s": round(wall, 6),
+            "stage_s": round(self.stage_s_total, 6),
+            "dispatch_overhead_s": round(overhead, 6),
+            "gap_s": round(self.gap_s_total, 6),
+            "transfers_bytes": {
+                "h2d": self.h2d_bytes, "d2h": self.d2h_bytes,
+            },
+            "top_stages": self.top_stages(),
+            "per_chunk": list(self.chunks),
+        }

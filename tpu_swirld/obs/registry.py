@@ -264,6 +264,96 @@ class Registry:
     def to_json(self, indent: Optional[int] = None) -> str:
         return json.dumps(self.to_dict(), indent=indent, sort_keys=True)
 
+    # ------------------------------------------------- structured samples
+
+    def to_samples(self) -> List[Dict]:
+        """Structured, JSON-ready sample list that survives a wire hop
+        and reloads losslessly via :meth:`load_samples` — the body of a
+        cluster ``KIND_METRICS`` reply.  Unlike :meth:`to_dict` (whose
+        keys are rendered label strings), labels stay a dict so a
+        receiver can re-label (e.g. add ``node=...``) before merging."""
+        out: List[Dict] = []
+        for m in self.metrics():
+            s: Dict = {
+                "name": m.name, "kind": m.kind, "labels": dict(m.labels),
+            }
+            if isinstance(m, Histogram):
+                s["buckets"] = [float(b) for b in m.buckets]
+                s["bucket_counts"] = list(m.bucket_counts)
+                s["count"] = m.count
+                s["sum"] = m.sum
+                s["min"] = None if m.count == 0 else m.min
+                s["max"] = None if m.count == 0 else m.max
+            else:
+                s["value"] = m.value
+            out.append(s)
+        return out
+
+    def load_samples(
+        self, samples: List[Dict], extra_labels: LabelDict = None,
+    ) -> None:
+        """Merge :meth:`to_samples` output into this registry, optionally
+        re-labeled (``extra_labels``).  Counters and histograms *add*
+        (so loading N node snapshots yields cluster totals when the
+        extra labels are omitted); gauges overwrite."""
+        for s in samples:
+            labels = dict(s.get("labels") or {})
+            labels.update(extra_labels or {})
+            kind = s.get("kind")
+            if kind == "counter":
+                self.counter(s["name"], labels).inc(float(s["value"]))
+            elif kind == "gauge":
+                self.gauge(s["name"], labels).set(float(s["value"]))
+            elif kind == "histogram":
+                h = self.histogram(
+                    s["name"], labels, buckets=tuple(s["buckets"]),
+                )
+                counts = [int(c) for c in s["bucket_counts"]]
+                if len(counts) != len(h.bucket_counts):
+                    raise ValueError(
+                        f"histogram {s['name']!r}: bucket count mismatch"
+                    )
+                for i, c in enumerate(counts):
+                    h.bucket_counts[i] += c
+                h.count += int(s["count"])
+                h.sum += float(s["sum"])
+                if s.get("min") is not None and s["min"] < h.min:
+                    h.min = float(s["min"])
+                if s.get("max") is not None and s["max"] > h.max:
+                    h.max = float(s["max"])
+            else:
+                raise ValueError(f"unknown sample kind {kind!r}")
+
+
+def merge_node_samples(per_node: Dict[str, List[Dict]]) -> "Registry":
+    """One merged registry from per-node sample lists: every sample is
+    re-labeled with its ``node``, so the Prometheus exposition carries
+    the whole fleet without name collisions."""
+    merged = Registry()
+    for node in sorted(per_node):
+        merged.load_samples(per_node[node], extra_labels={"node": node})
+    return merged
+
+
+def rollup_node_samples(per_node: Dict[str, List[Dict]]) -> Dict[str, float]:
+    """Cluster-wide scalar rollup: counters and gauges summed across
+    nodes per (name, labels) — the at-a-glance fleet totals the verdict
+    and the report CLI render."""
+    totals: Dict[str, float] = {}
+    for node in sorted(per_node):
+        for s in per_node[node]:
+            if s.get("kind") == "histogram":
+                key = s["name"] + _render_labels(
+                    _label_key(s.get("labels") or {})
+                ) + "_count"
+                totals[key] = totals.get(key, 0.0) + float(s["count"])
+            else:
+                key = s["name"] + _render_labels(
+                    _label_key(s.get("labels") or {})
+                )
+                totals[key] = totals.get(key, 0.0) + float(s["value"])
+    return {k: _num(v) for k, v in sorted(totals.items())}
+
 
 def _num(v: float):
     """Render integral floats as ints (counters are usually counts)."""

@@ -13,13 +13,28 @@ and prints:
    finality (rounds-to-decision, time-to-finality, decided watermarks) /
    flight-recorder (trigger + dump counters) / resilience sections.
 
+Two additional modes (PR 16):
+
+- pointing the CLI at an old ``BENCH_*.json`` *bench artifact* (a plain
+  JSON result doc, not a trace) renders every section as ``n/a`` with
+  the artifact's own metric line, and exits 0 — it must never traceback
+  on the repo's own historical outputs;
+- ``--cluster-dir <workdir>`` renders the *fleet view* from a cluster
+  run's on-disk leavings (``node-*.report.json``, ``metrics.json``,
+  ``merged.trace.json``): per-node fleet table, finality, shed /
+  backpressure, WAL recovery, circuit-breaker sections, and the
+  supervisor metrics rollup.  Missing keys render ``n/a`` — old report
+  versions stay readable.
+
 Pure stdlib + pure functions over the event list, so the CLI can be smoke-
 tested cheaply (``tests/test_obs.py``) and never rots silently.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+import json
+import os
+from typing import Dict, List, Optional, Tuple
 
 from tpu_swirld.obs.tracer import load_trace
 
@@ -251,6 +266,172 @@ def _gauge_name(g: Dict) -> str:
     return g["name"]
 
 
+# ------------------------------------------------------- artifact detection
+
+def classify_artifact(path: str) -> Tuple[str, object]:
+    """``("trace", events)`` for a trace file, ``("bench", obj)`` for a
+    bench result artifact (any plain JSON document that isn't trace
+    events — the old ``BENCH_*.json`` files the CLI must not crash on)."""
+    with open(path) as f:
+        text = f.read()
+    stripped = text.lstrip()
+    if stripped.startswith("{") and '"traceEvents"' not in stripped[:200]:
+        try:
+            obj = json.loads(stripped)
+        except json.JSONDecodeError:
+            obj = None
+        # a lone single-line trace event is still a trace ("ph" marks it)
+        if isinstance(obj, dict) and "ph" not in obj:
+            return "bench", obj
+    return "trace", load_trace(path)
+
+
+def render_bench_stub(path: str, obj: Dict) -> str:
+    """The graceful non-trace rendering: every trace section present but
+    ``n/a``, plus whatever headline metric the artifact itself carries."""
+    lines = [
+        f"(not a trace: bench artifact {os.path.basename(path)})",
+        "",
+        "== phase breakdown ==",
+        "n/a",
+        "",
+        "== protocol gauges ==",
+        "n/a",
+    ]
+    parsed = obj.get("parsed")
+    if isinstance(parsed, dict):
+        parsed = [parsed]
+    if isinstance(parsed, list):
+        lines.append("")
+        lines.append("== bench artifact metrics ==")
+        for row in parsed:
+            if not isinstance(row, dict):
+                continue
+            metric = row.get("metric", "n/a")
+            value = row.get("value", "n/a")
+            unit = row.get("unit", "")
+            lines.append(f"{metric}: {value} {unit}".rstrip())
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------ cluster view
+
+def _fmt(v, nd: int = 3) -> str:
+    if v is None:
+        return "n/a"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def _counter_section(lines: List[str], title: str, reports: List[Dict],
+                     names: Tuple[str, ...]) -> None:
+    """One fleet counter table: per-counter per-node values + total,
+    ``n/a`` where a node's report predates the counter."""
+    lines.append("")
+    lines.append(f"== {title} ==")
+    for name in names:
+        vals = [
+            (r.get("counters") or {}).get(name) for r in reports
+        ]
+        known = [v for v in vals if v is not None]
+        total = sum(known) if known else None
+        per_node = " ".join(
+            f"{r.get('node', '?')}={_fmt(v, 0)}"
+            for r, v in zip(reports, vals)
+        )
+        lines.append(f"{name:<28} total={_fmt(total, 0):<8} {per_node}")
+
+
+def render_cluster_report(dirpath: str) -> str:
+    """The fleet view over a cluster workdir's on-disk artifacts."""
+    reports: List[Dict] = []
+    for name in sorted(os.listdir(dirpath)):
+        if name.startswith("node-") and name.endswith(".report.json"):
+            try:
+                with open(os.path.join(dirpath, name)) as f:
+                    reports.append(json.load(f))
+            except (OSError, json.JSONDecodeError):
+                continue
+    lines: List[str] = [f"== cluster fleet ({len(reports)} node reports) =="]
+    if not reports:
+        lines.append("n/a (no node-*.report.json found)")
+    else:
+        lines.append(
+            f"{'node':<6} {'events':>7} {'decided':>8} {'decided_tx':>10} "
+            f"{'unclean':>8} {'trace_ev':>9} {'dropped':>8}"
+        )
+        for r in reports:
+            lines.append(
+                f"{_fmt(r.get('node')):<6} {_fmt(r.get('events')):>7} "
+                f"{_fmt(len(r['decided']) if 'decided' in r else None):>8} "
+                f"{_fmt(r.get('decided_tx')):>10} "
+                f"{_fmt(r.get('unclean_start')):>8} "
+                f"{_fmt(r.get('trace_events')):>9} "
+                f"{_fmt(r.get('trace_dropped')):>8}"
+            )
+        lines.append("")
+        lines.append("== finality (per node) ==")
+        for r in reports:
+            fin = r.get("finality") or {}
+            lines.append(
+                f"{_fmt(r.get('node')):<6} decided={_fmt(fin.get('decided'))}"
+                f" rtd_p50={_fmt(fin.get('rtd_p50'))}"
+                f" rtd_p99={_fmt(fin.get('rtd_p99'))}"
+                f" ttf_p50={_fmt(fin.get('ttf_p50'))}"
+                f" ttf_p99={_fmt(fin.get('ttf_p99'))}"
+                f" undecided={_fmt(fin.get('undecided'))}"
+            )
+        _counter_section(
+            lines, "shed / backpressure", reports,
+            ("tx_submitted", "tx_accepted", "tx_duplicate",
+             "tx_shed_pool", "tx_shed_window", "tx_shed_oversize"),
+        )
+        _counter_section(
+            lines, "WAL recovery", reports,
+            ("wal_torn_tail_recovered",),
+        )
+        _counter_section(
+            lines, "circuit breaker / retries", reports,
+            ("node_circuit_opens", "node_retries",
+             "node_bad_replies", "node_bad_requests"),
+        )
+    metrics_path = os.path.join(dirpath, "metrics.json")
+    lines.append("")
+    lines.append("== supervisor metrics rollup ==")
+    if os.path.exists(metrics_path):
+        try:
+            with open(metrics_path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            doc = {}
+        lines.append(
+            f"polls={_fmt(doc.get('polls'))} "
+            f"nodes={_fmt(len(doc.get('nodes', {})) or None, 0)}"
+        )
+        rollup = doc.get("rollup") or {}
+        for key in sorted(rollup):
+            lines.append(f"{key:<44} {_fmt(rollup[key])}")
+        if not rollup:
+            lines.append("n/a (empty rollup)")
+    else:
+        lines.append("n/a (no metrics.json — supervisor polling off?)")
+    merged = os.path.join(dirpath, "merged.trace.json")
+    lines.append("")
+    lines.append("== merged cross-process trace ==")
+    if os.path.exists(merged):
+        lines.append(merged)
+        lines.append(
+            "(open in Perfetto; re-summarize with "
+            "python -m tpu_swirld.obs.cluster_trace "
+            f"{dirpath})"
+        )
+    else:
+        lines.append("n/a (no merged.trace.json — run "
+                     f"python -m tpu_swirld.obs.cluster_trace {dirpath})")
+    return "\n".join(lines)
+
+
 def main(argv: List[str]) -> int:
     import argparse
 
@@ -260,10 +441,22 @@ def main(argv: List[str]) -> int:
     )
     sub = ap.add_subparsers(dest="cmd", required=True)
     rep = sub.add_parser("report", help="render a trace file as tables")
-    rep.add_argument("trace", help="JSONL (or Chrome-wrapped) trace file")
+    rep.add_argument("trace", nargs="?", default=None,
+                     help="JSONL (or Chrome-wrapped) trace file")
+    rep.add_argument("--cluster-dir", default=None,
+                     help="render the fleet view of a cluster workdir "
+                          "instead of a single trace")
     args = ap.parse_args(argv)
     if args.cmd == "report":
-        events = load_trace(args.trace)
-        print(render_report(events))
+        if args.cluster_dir is not None:
+            print(render_cluster_report(args.cluster_dir))
+            return 0
+        if args.trace is None:
+            ap.error("a trace file (or --cluster-dir) is required")
+        kind, payload = classify_artifact(args.trace)
+        if kind == "bench":
+            print(render_bench_stub(args.trace, payload))
+            return 0
+        print(render_report(payload))
         return 0
     return 2

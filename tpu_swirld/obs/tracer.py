@@ -20,26 +20,66 @@ shared no-op context manager — no allocation, no timestamps, nothing
 recorded — so instrumentation can unconditionally ``with tracer.span(...)``
 once it holds *a* tracer.  Call sites that may hold ``None`` instead should
 branch (``if tracer is not None``), which is the pattern the hot paths use.
+
+Trace identity (cluster mode): every enabled span gets a process-unique
+``span_id`` (upper bits derived from the tracer ``pid`` so ids from
+different node processes never collide in a merged timeline).  A span may
+additionally belong to a *trace* — an 8-byte id carried across process
+boundaries inside the 16-byte wire context built by :func:`pack_context`
+(trace id + parent span id).  :meth:`Tracer.span_under` opens a span whose
+parent lives in another process; :meth:`Tracer.active_context` exports the
+innermost traced span as wire bytes for the transport to stamp onto
+outgoing frames.  ``obs/cluster_trace.py`` reassembles the shards.
 """
 
 from __future__ import annotations
 
 import json
+import struct
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
+
+#: wire size of a packed trace context (8-byte trace id + u64 span id)
+TRACE_CTX_LEN = 16
+
+_CTX = struct.Struct("<8sQ")
+
+
+def pack_context(trace_id: bytes, span_id: int) -> bytes:
+    """Pack an (8-byte trace id, span id) pair into wire bytes."""
+    if len(trace_id) != 8:
+        raise ValueError(f"trace id must be 8 bytes, got {len(trace_id)}")
+    return _CTX.pack(trace_id, span_id)
+
+
+def unpack_context(ctx: bytes) -> Tuple[bytes, int]:
+    """Inverse of :func:`pack_context`; raises ``ValueError`` on bad size."""
+    if len(ctx) != TRACE_CTX_LEN:
+        raise ValueError(
+            f"trace context must be {TRACE_CTX_LEN} bytes, got {len(ctx)}"
+        )
+    return _CTX.unpack(ctx)
 
 
 class _SpanHandle:
     """Mutable args bag yielded by ``Tracer.span`` — mutate ``args`` inside
     the ``with`` block to attach data to the emitted event."""
 
-    __slots__ = ("name", "args", "_t0_mono", "_wall_s")
+    __slots__ = (
+        "name", "args", "_t0_mono", "_wall_s",
+        "span_id", "trace_id", "parent_id",
+    )
 
-    def __init__(self, name: str, args: Dict, t0_mono: float, wall_s: float):
+    def __init__(self, name: str, args: Dict, t0_mono: float, wall_s: float,
+                 trace_id: Optional[bytes] = None,
+                 parent_id: Optional[int] = None):
         self.name = name
         self.args = args
         self._t0_mono = t0_mono
         self._wall_s = wall_s
+        self.span_id = 0
+        self.trace_id = trace_id
+        self.parent_id = parent_id
 
 
 class _NullSpan:
@@ -73,8 +113,17 @@ class NullTracer:
     def span(self, name: str, **args):
         return _NULL_SPAN
 
+    def span_under(self, name: str, ctx=None, **args):
+        return _NULL_SPAN
+
     def instant(self, name: str, **args) -> None:
         pass
+
+    def active_context(self):
+        return None
+
+    def active_trace_hex(self):
+        return None
 
     def save(self, path: str) -> None:
         raise RuntimeError("NullTracer records nothing; nothing to save")
@@ -94,16 +143,34 @@ class _SpanCtx:
 
     def __enter__(self) -> _SpanHandle:
         h = self._handle
+        t = self._tracer
+        h.span_id = t._new_span_id()
+        if t._stack:
+            top = t._stack[-1]
+            # inherit trace identity / local parent from the enclosing span
+            # unless a remote parent context was given explicitly
+            if h.trace_id is None:
+                h.trace_id = top.trace_id
+            if h.parent_id is None:
+                h.parent_id = top.span_id
         h._wall_s = time.time()
         h._t0_mono = time.perf_counter()   # re-stamped at entry, not creation
-        self._tracer._stack.append(h)
+        t._stack.append(h)
         return h
 
     def __exit__(self, *exc):
         t = self._tracer
         h = t._stack.pop()
         end = time.perf_counter()
-        t.events.append(
+        args = dict(
+            h.args, depth=len(t._stack), wall_s=round(h._wall_s, 6),
+            span_id=h.span_id,
+        )
+        if h.parent_id is not None:
+            args["parent_span_id"] = h.parent_id
+        if h.trace_id is not None:
+            args["trace"] = h.trace_id.hex()
+        t._append(
             {
                 "name": h.name,
                 "ph": "X",
@@ -111,9 +178,7 @@ class _SpanCtx:
                 "tid": t.tid,
                 "ts": round((h._t0_mono - t._epoch_mono) * 1e6, 3),
                 "dur": round((end - h._t0_mono) * 1e6, 3),
-                "args": dict(
-                    h.args, depth=len(t._stack), wall_s=round(h._wall_s, 6)
-                ),
+                "args": args,
             }
         )
         return False
@@ -124,15 +189,33 @@ class Tracer:
 
     enabled = True
 
-    def __init__(self, pid: int = 0, tid: int = 0):
+    def __init__(self, pid: int = 0, tid: int = 0,
+                 max_events: Optional[int] = None):
         self.pid = pid
         self.tid = tid
         self.events: List[Dict] = []
+        self.dropped = 0
+        self.max_events = max_events
         self._stack: List[_SpanHandle] = []
         self._epoch_mono = time.perf_counter()
         self._epoch_wall = time.time()
+        self._span_seq = 0
 
     # ------------------------------------------------------------ recording
+
+    def _new_span_id(self) -> int:
+        """Process-unique span id: pid in the upper bits, a sequence number
+        below, so shards from different node processes never collide."""
+        self._span_seq += 1
+        return (((self.pid & 0xFFFF) + 1) << 32) | self._span_seq
+
+    def _append(self, event: Dict) -> None:
+        """Record one event, honoring the optional ``max_events`` cap
+        (long soaks keep bounded memory; drops are counted, not silent)."""
+        if self.max_events is not None and len(self.events) >= self.max_events:
+            self.dropped += 1
+            return
+        self.events.append(event)
 
     def span(self, name: str, **args) -> _SpanCtx:
         """Context manager timing a nested span.  Yields a handle whose
@@ -141,9 +224,48 @@ class Tracer:
             self, _SpanHandle(name, args, time.perf_counter(), time.time())
         )
 
+    def span_under(self, name: str, ctx: Optional[bytes] = None,
+                   **args) -> _SpanCtx:
+        """Like :meth:`span`, but parented under a *wire* trace context
+        (16 bytes from :func:`pack_context`, e.g. received in a frame
+        header).  ``None``/empty ctx degrades to a plain :meth:`span`; a
+        zero parent span id means "root of the trace"."""
+        if not ctx:
+            return self.span(name, **args)
+        trace_id, parent = unpack_context(ctx)
+        return _SpanCtx(
+            self,
+            _SpanHandle(
+                name, args, time.perf_counter(), time.time(),
+                trace_id=trace_id, parent_id=parent if parent else None,
+            ),
+        )
+
+    def active_context(self) -> Optional[bytes]:
+        """Wire context of the innermost *traced* open span (16 bytes), or
+        ``None`` when no open span carries a trace id.  This is what the
+        socket transport stamps onto outgoing frames."""
+        for h in reversed(self._stack):
+            if h.trace_id is not None:
+                return pack_context(h.trace_id, h.span_id)
+        return None
+
+    def active_trace_hex(self) -> Optional[str]:
+        """Hex trace id of the innermost traced open span, or ``None``
+        (flight-recorder dumps embed this for cross-shard correlation)."""
+        for h in reversed(self._stack):
+            if h.trace_id is not None:
+                return h.trace_id.hex()
+        return None
+
     def instant(self, name: str, **args) -> None:
         """A zero-duration marker event (Chrome ``ph: "i"``)."""
-        self.events.append(
+        args = dict(args, depth=len(self._stack))
+        args.setdefault("wall_s", round(time.time(), 6))
+        trace = self.active_trace_hex()
+        if trace is not None:
+            args.setdefault("trace", trace)
+        self._append(
             {
                 "name": name,
                 "ph": "i",
@@ -151,7 +273,7 @@ class Tracer:
                 "tid": self.tid,
                 "ts": round((time.perf_counter() - self._epoch_mono) * 1e6, 3),
                 "s": "t",
-                "args": dict(args, depth=len(self._stack)),
+                "args": args,
             }
         )
 
@@ -178,7 +300,7 @@ class Tracer:
         self, name: str, value: float, labels: Optional[Dict] = None
     ) -> None:
         """Record a Chrome counter sample."""
-        self.events.append(self.counter_event(name, value, labels))
+        self._append(self.counter_event(name, value, labels))
 
     @property
     def depth(self) -> int:

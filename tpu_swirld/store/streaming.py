@@ -359,10 +359,10 @@ class StreamingConsensus(IncrementalConsensus):
         # warm the archive's decompression cache while the device pulls
         # below drain — the widening's fetch then hits hot rows
         arch.prefetch(lo2, lo)
-        # ---- host pulls of the live window
-        anc_cur = np.asarray(self._anc_d)
-        sees_cur = np.asarray(self._sees_d) if has_forks else anc_cur
-        ssm_cur = np.asarray(self._ssm_d)
+        # ---- host pulls of the live window (profiler-counted D2H)
+        anc_cur = obs.to_host(self._anc_d)
+        sees_cur = obs.to_host(self._sees_d) if has_forks else anc_cur
+        ssm_cur = obs.to_host(self._ssm_d)
         # ---- re-fetch archived rows over global columns [lo2, hi),
         # decompressing straight into the widened slab (anc_pre is a view
         # of anc_w — no intermediate delta x w2 copy)

@@ -2156,6 +2156,11 @@ class IncrementalConsensus:
         ``window_size``, ``pruned_prefix``, ``rebased``, ``seconds``.
         """
         t0 = time.perf_counter()
+        _o = obs.current()
+        if _o is not None and _o.profiler is not None:
+            # one profiler chunk per pass: _stats() closes it, so every
+            # return path yields a dispatch-overhead breakdown row
+            _o.profiler.begin_chunk()
         n_before = len(self.packer)
         self.packer.extend(events)
         n_total = len(self.packer)
@@ -2293,6 +2298,8 @@ class IncrementalConsensus:
                 self._consensus_round - 1,
             )
         self._latency_phase = self._latency_phase_default
+        if o is not None and o.profiler is not None:
+            o.profiler.end_chunk(n_events=int(n_new))
         return {
             "new_events": int(n_new),
             "ordered": ordered,
@@ -2665,13 +2672,13 @@ class IncrementalConsensus:
                     tot_stake=self._tot, r_max=self._r_cap,
                     s_max=self._s_cap, has_forks=has_forks, chunk=chunk,
                 )
-                tab = np.asarray(out[2])
+                tab = obs.to_host(out[2])
                 registered = np.unique(tab[tab >= 0])
                 missing = registered[self._colpos_w[registered] < 0]
                 if missing.size == 0:
                     state = out
                     break
-                rnd_np = np.asarray(out[0])
+                rnd_np = obs.to_host(out[0])
                 ce = np.arange(start, start + chunk, dtype=np.int64)
                 pc = self._parents_w[ce]
                 r0 = np.where(
@@ -2696,13 +2703,13 @@ class IncrementalConsensus:
             else:
                 raise RuntimeError("witness-column chunk did not converge")
 
-        # np.array (not asarray): device pulls are read-only views, and
-        # these mirrors are mutated in place by the roll/prune paths
-        rnd_w = np.array(state[0])
-        wits_w = np.array(state[1])
-        tab_np = np.array(state[2])
-        cnt_np = np.array(state[3])
-        if int(np.asarray(state[4])):
+        # copy=True (np.array, not asarray): device pulls are read-only
+        # views, and these mirrors are mutated in place by roll/prune
+        rnd_w = obs.to_host(state[0], copy=True)
+        wits_w = obs.to_host(state[1], copy=True)
+        tab_np = obs.to_host(state[2], copy=True)
+        cnt_np = obs.to_host(state[3], copy=True)
+        if int(obs.to_host(state[4])):
             # round/slot capacity overflow -> rebase, which self-heals:
             # _columns_pass grows the flagged capacity and the adopted
             # window table inherits it (never a crash)
@@ -2740,11 +2747,11 @@ class IncrementalConsensus:
             matmul_dtype_name=self._mm,
         )
         fam = np.full((self._r_cap, self._s_cap), -1, np.int8)
-        fam[: self._r_fame] = np.asarray(famous_d).reshape(
+        fam[: self._r_fame] = obs.to_host(famous_d).reshape(
             self._r_fame, self._s_cap
         )
         dec = np.full((self._r_cap, self._s_cap), -1, np.int32)
-        dec[: self._r_fame] = np.asarray(dec_d).reshape(
+        dec[: self._r_fame] = obs.to_host(dec_d).reshape(
             self._r_fame, self._s_cap
         )
         self._famous_np = fam
@@ -2782,9 +2789,9 @@ class IncrementalConsensus:
                 r_max=r_ord_eff, s_max=self._s_cap,
                 chain=self._chain_cap,
             )
-            rr_np = np.asarray(rr_d)
-            tsr_np = np.asarray(ts_d)
-            recv_np = np.array(recv_d)
+            rr_np = obs.to_host(rr_d)
+            tsr_np = obs.to_host(ts_d)
+            recv_np = obs.to_host(recv_d, copy=True)
             max_dec = self._frozen_vote_hi
             for k in range(k_done, ncomp):
                 slots = self._tab_np[k]
