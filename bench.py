@@ -42,6 +42,12 @@ All detail goes to stderr.  Environment knobs:
     incremental section.
     BENCH_STREAM_MEMBERS (256)  BENCH_STREAM_EVENTS (100000)
     BENCH_STREAM_CHUNK (2048)  BENCH_STREAM_ORACLE (4000)
+    BENCH_DEFAULT_STREAM_MEMBERS (48)  BENCH_DEFAULT_STREAM_EVENTS (6000)
+    BENCH_DEFAULT_STREAM_CHUNK (1024) — the default (no-flags) run's
+    always-on scaled-down streaming leg, so stream.evps and
+    stream.dispatch_overhead_s land in every artifact (0 events
+    disables); fusion/overlap knobs via SWIRLD_FUSE_CHUNKS /
+    SWIRLD_DECODE_OVERLAP / SWIRLD_DECODE_QUEUE_DEPTH.
     BENCH_STREAM_REF (20000) — with --mesh: events for the in-run
     single-device reference pass (0 disables); BENCH_STREAM_SINGLE_EVPS
     supplies the reference throughput externally instead (e.g. from a
@@ -82,6 +88,25 @@ STREAM_CHUNK = int(os.environ.get("BENCH_STREAM_CHUNK", "2048"))
 # decided-prefix order parity to be non-vacuous (the JSON reports
 # oracle_decided so a too-shallow override is visible)
 STREAM_ORACLE = int(os.environ.get("BENCH_STREAM_ORACLE", "12000"))
+
+# always-on streaming leg of the DEFAULT run, config-scaled down so the
+# headline stays cheap: every artifact then carries stream.evps and
+# stream.dispatch_overhead_s for bench_compare.py's EXTRA_KEYS gates
+# (previously only --stream artifacts had them, so the fused-dispatch
+# path could regress invisibly between config-5 soaks).  0 events
+# disables the leg; the full config-5 shape remains behind --stream.
+# Gossip arrives in batches of 4x the ingest chunk so one ingest call
+# spans several deltas — that exercises BOTH the decode-overlap worker
+# (multi-slice _chunked_deltas) and the fused rounds scan.
+DEFAULT_STREAM_MEMBERS = int(
+    os.environ.get("BENCH_DEFAULT_STREAM_MEMBERS", "48")
+)
+DEFAULT_STREAM_EVENTS = int(
+    os.environ.get("BENCH_DEFAULT_STREAM_EVENTS", "6000")
+)
+DEFAULT_STREAM_CHUNK = int(
+    os.environ.get("BENCH_DEFAULT_STREAM_CHUNK", "1024")
+)
 
 
 def log(*a):
@@ -352,6 +377,96 @@ def run_default():
         }
         finality["incremental"] = inc.finality.summary()
 
+    # ---- always-on streaming leg (config-scaled down) ----
+    # Profiled ingest through StreamingConsensus so stream.evps and
+    # stream.dispatch_overhead_s land in EVERY artifact; decided output
+    # is parity-checked bit-identically against the batch pipeline over
+    # the same events.  Both sides of a bench_compare gate measure the
+    # same way (profiler ambient), so the numbers are comparable even
+    # though the profiler adds per-stage sync.
+    stream_out = None
+    if DEFAULT_STREAM_EVENTS > 0:
+        from tpu_swirld.config import SwirldConfig, resolve_stream_settings
+        from tpu_swirld.obs.profile import DispatchProfiler
+        from tpu_swirld.sim import stream_gossip_dag
+        from tpu_swirld.store import StreamingConsensus
+
+        s_cfg = SwirldConfig(n_members=DEFAULT_STREAM_MEMBERS)
+        s_members, s_stake, _s_keys, s_chunks = stream_gossip_dag(
+            DEFAULT_STREAM_MEMBERS, DEFAULT_STREAM_EVENTS,
+            4 * DEFAULT_STREAM_CHUNK, seed=1,
+        )
+        s_chunks = list(s_chunks)
+        s_events = [ev for ch in s_chunks for ev in ch]
+        s_packed = pack_events(s_events, s_members, s_stake)
+        with o.tracer.span("stream_default_ref"), \
+                mon.phase("stream_default_ref"):
+            s_ref = run_consensus(s_packed, s_cfg)
+
+        settings = resolve_stream_settings(s_cfg)
+
+        def _stream_pass(profiler):
+            eng = StreamingConsensus(
+                s_members, s_stake, s_cfg,
+                ingest_chunk=DEFAULT_STREAM_CHUNK,
+                window_bucket=2048, prune_min=1024,
+            )
+            t0 = time.time()
+            if profiler is not None:
+                with obslib.enabled(obslib.Obs(profiler=profiler)):
+                    for ch in s_chunks:
+                        eng.ingest(ch)
+            else:
+                for ch in s_chunks:
+                    eng.ingest(ch)
+            dt = time.time() - t0
+            eng.store.close()
+            return eng, dt
+
+        # pass 1 (timed, untraced): the leg's evps + parity.  Pass 2
+        # re-runs under the DispatchProfiler on the now-warm jit caches —
+        # profiling the cold pass would book every one-off compile into
+        # dispatch_overhead_s and drown the per-chunk signal being gated.
+        with o.tracer.span("stream_default"), mon.phase("stream_default"):
+            eng, t_s = _stream_pass(None)
+        prof = DispatchProfiler()
+        with o.tracer.span("stream_default_profile"), \
+                mon.phase("stream_default_profile"):
+            _eng2, _t2 = _stream_pass(prof)
+        s_res = eng.result()
+        got = [eng.packer.event_id(i) for i in s_res.order]
+        want = [s_packed.ids[i] for i in s_ref.order]
+        ref_round = {
+            s_packed.ids[i]: int(s_ref.round[i]) for i in range(len(s_events))
+        }
+        s_parity = got == want and all(
+            int(s_res.round[i]) == ref_round[eng.packer.event_id(i)]
+            for i in range(len(s_events))
+        )
+        dispatch = prof.summary()
+        s_evps = DEFAULT_STREAM_EVENTS / t_s
+        log(f"[stream-default] {DEFAULT_STREAM_EVENTS} ev x "
+            f"{DEFAULT_STREAM_MEMBERS} members in {t_s:.2f}s = "
+            f"{s_evps:.0f} ev/s fuse={settings['fuse_chunks']} "
+            f"decode_overlap={settings['decode_overlap']} "
+            f"dispatch_overhead={dispatch['dispatch_overhead_s']:.3f}s "
+            f"fused_dispatches={dispatch['fused_dispatches']} "
+            f"parity={s_parity}")
+        stream_out = {
+            "evps": round(s_evps, 1),
+            # dotted keys bench_compare.py gates directly
+            "dispatch_overhead_s": dispatch["dispatch_overhead_s"],
+            "members": DEFAULT_STREAM_MEMBERS,
+            "events": DEFAULT_STREAM_EVENTS,
+            "chunk": DEFAULT_STREAM_CHUNK,
+            "fuse_chunks": settings["fuse_chunks"],
+            "decode_overlap": settings["decode_overlap"],
+            "decoded_off_thread": eng.decoded_off_thread,
+            "ordered": len(s_res.order),
+            "parity": bool(s_parity),
+            "profile": dispatch,
+        }
+
     phases = {k: round(v, 4) for k, v in o.tracer.phase_seconds().items()}
     if inc_out is not None:
         phases["incremental_window_size"] = inc_out["window_size"]
@@ -379,6 +494,8 @@ def run_default():
     }
     if inc_out is not None:
         out["incremental"] = inc_out
+    if stream_out is not None:
+        out["stream"] = stream_out
     out["finality"] = {
         eng: {
             k: (round(v, 6) if isinstance(v, float) else v)
@@ -392,7 +509,8 @@ def run_default():
     out["scale_audit"] = scale_audit_stamp()
     print(json.dumps(out), flush=True)
     mon.close()
-    if not parity or (inc_out is not None and not inc_out["parity"]):
+    if not parity or (inc_out is not None and not inc_out["parity"]) \
+            or (stream_out is not None and not stream_out["parity"]):
         sys.exit(1)
 
 

@@ -3,8 +3,47 @@
 #   1. invariant linter over the package (AST rules SW001..)
 #   2. scale audit at the baseline envelope (jaxpr interval/dtype flow,
 #      rules SW008-SW011) across all engines
+#   3. fused-dispatch modules (the megadispatch rounds span and its
+#      feeders) must be SW003/SW004-clean with JUSTIFIED suppressions
+#      only: a bare "# swirld-lint: disable=SW003" (no "-- why" note) or
+#      a file-wide disable in these files fails, mirroring the SW008
+#      flow-audit semantics — wall-clock reads or unpinned dtypes inside
+#      the fused scan would silently break the async==sync parity and
+#      the donation-carry dtype contract.
 # Usage: scripts/lint.sh [paths...]   (default: the tpu_swirld package)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 env JAX_PLATFORMS=cpu python -m tpu_swirld.analysis lint "${@:-tpu_swirld}"
-exec env JAX_PLATFORMS=cpu python -m tpu_swirld.analysis scale-audit --envelope baseline
+env JAX_PLATFORMS=cpu python -m tpu_swirld.analysis scale-audit --envelope baseline
+env JAX_PLATFORMS=cpu python - <<'EOF'
+import sys
+
+from tpu_swirld.analysis.lint import lint_paths, _suppression_comments
+
+FUSED_MODULES = [
+    "tpu_swirld/tpu/pipeline.py",
+    "tpu_swirld/tpu/pallas_kernels.py",
+    "tpu_swirld/store/streaming.py",
+    "tpu_swirld/parallel.py",
+]
+GUARDED = {"SW003", "wall-clock", "SW004", "dtype-discipline", "all"}
+
+bad = [f.render() for f in lint_paths(FUSED_MODULES, rules=["SW003", "SW004"])]
+for path in FUSED_MODULES:
+    with open(path, encoding="utf-8") as fh:
+        src = fh.read()
+    for lineno, kind, ids, note in _suppression_comments(src):
+        if not (ids & GUARDED):
+            continue
+        if kind == "file" or not note:
+            bad.append(
+                f"{path}:{lineno}: unjustified suppression of "
+                f"{','.join(sorted(ids & GUARDED))} in a fused-dispatch "
+                f"module (needs a line disable with a '-- why' note)"
+            )
+for line in bad:
+    print(line)
+print(f"fused-kernel SW003/SW004 gate: "
+      f"{len(bad)} finding{'s' if len(bad) != 1 else ''}")
+sys.exit(1 if bad else 0)
+EOF

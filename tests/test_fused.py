@@ -1,0 +1,221 @@
+"""Fused rounds-span dispatch + decode overlap: exactness pins.
+
+The fused scan (``pipeline.rounds_span_stage``) batches K packed chunks
+per dispatch behind a fixpoint witness-column probe, and the streaming
+driver's decode worker pre-hashes the next delta's event ids off-thread
+behind a drain barrier.  Both are pure latency plays: every output must
+be bit-identical to the unfused, synchronous path over ANY chunking,
+fork pattern, rebase, or ragged span tail — commit boundaries and
+thread scheduling never influence consensus outputs.
+"""
+
+import random
+
+import pytest
+
+from tpu_swirld.config import SwirldConfig, resolve_stream_settings
+from tpu_swirld.oracle.event import Event
+from tpu_swirld.packing import pack_events
+from tpu_swirld.sim import generate_gossip_dag, make_simulation, \
+    make_straggler_event
+from tpu_swirld.store import StreamingConsensus
+from tpu_swirld.tpu.pipeline import run_consensus
+
+from tests.test_incremental import assert_same_result
+
+
+def drive(members, stake, config, chunks, **kw):
+    inc = StreamingConsensus(members, stake, config, **kw)
+    for chunk in chunks:
+        inc.ingest(chunk)
+    return inc
+
+
+def random_chunks(events, seed, sizes=(2, 30, 90, 200)):
+    rng = random.Random(seed)
+    out, i = [], 0
+    while i < len(events):
+        c = rng.choice(sizes)
+        out.append(events[i : i + c])
+        i += c
+    return out
+
+
+# ------------------------------------------------------ fused == unfused
+
+
+@pytest.mark.parametrize("fuse", [3, 8])
+def test_fused_vs_unfused_random_chunks_with_forks(fuse):
+    """Fused span dispatch vs the per-chunk loop vs one batch pass over
+    forked history with randomly sized ingest chunks: bit-identical.
+    fuse=3 keeps the span tail ragged (n_chunks % 3 != 0 for most
+    deltas), fuse=8 is the shipped default."""
+    members, stake, events, _keys = generate_gossip_dag(
+        12, 1400, seed=4, n_forkers=4
+    )
+    packed = pack_events(events, members, stake)
+    assert len(packed.fork_pairs) > 0
+    cfg = SwirldConfig(n_members=12)
+    chunks = random_chunks(events, 7)
+    unfused = drive(
+        members, stake, cfg, chunks,
+        chunk=64, window_bucket=512, prune_min=128, ingest_chunk=256,
+        fuse_chunks=1,
+    )
+    fused = drive(
+        members, stake, cfg, chunks,
+        chunk=64, window_bucket=512, prune_min=128, ingest_chunk=256,
+        fuse_chunks=fuse,
+    )
+    assert fused._fuse == fuse and unfused._fuse == 1
+    assert_same_result(fused.result(), unfused.result())
+    assert_same_result(fused.result(), run_consensus(packed, cfg))
+
+
+def test_fused_ragged_span_tail():
+    """ingest_chunk = 5 scan chunks with fuse_chunks = 4: every delta
+    dispatches one full span (k=4) plus a ragged tail span (k=1), each
+    with its own static trip count — outputs identical to unfused."""
+    members, stake, events, _keys = generate_gossip_dag(8, 1000, seed=9)
+    cfg = SwirldConfig(n_members=8)
+    chunks = [events[i : i + 320] for i in range(0, len(events), 320)]
+    fused = drive(
+        members, stake, cfg, chunks,
+        chunk=64, window_bucket=512, prune_min=128, ingest_chunk=320,
+        fuse_chunks=4,
+    )
+    unfused = drive(
+        members, stake, cfg, chunks,
+        chunk=64, window_bucket=512, prune_min=128, ingest_chunk=320,
+        fuse_chunks=1,
+    )
+    assert_same_result(fused.result(), unfused.result())
+    assert_same_result(
+        fused.result(),
+        run_consensus(pack_events(events, members, stake), cfg),
+    )
+
+
+def test_fused_widening_rebase_mid_stream():
+    """A stale-view sync referencing long-pruned history while fusion is
+    on: the widening rebase re-fetches archived tiles and the fused
+    re-extension over the widened window stays bit-identical."""
+    members, stake, events, keys = generate_gossip_dag(8, 2000, seed=11)
+    cfg = SwirldConfig(n_members=8)
+    inc = StreamingConsensus(
+        members, stake, cfg, chunk=64, window_bucket=256, prune_min=64,
+        ingest_chunk=256, fuse_chunks=4,
+    )
+    for i in range(0, len(events), 200):
+        inc.ingest(events[i : i + 200])
+    assert inc.pruned_prefix > 500
+    pk3, sk3 = keys[3]
+    head3 = [ev for ev in events if ev.c == pk3][-1]
+    old0 = events[100]            # long received, long pruned
+    strag = Event(
+        d=b"stale-sync", p=(head3.id, old0.id), t=events[-1].t + 1, c=pk3
+    ).signed(sk3)
+    inc.ingest([strag])
+    assert inc.widen_rebases == 1
+    packed = pack_events(events + [strag], members, stake)
+    assert_same_result(inc.result(), run_consensus(packed, cfg))
+
+
+def test_fused_full_rebase_straggler_witness():
+    """A forged straggler witness below the frozen vote horizon routes
+    through the exact full-batch fallback with fusion on."""
+    sim = make_simulation(5, seed=23)
+    sim.run(260)
+    node = sim.nodes[0]
+    events = [node.hg[e] for e in node.order_added]
+    stake = [node.stake[m] for m in node.members]
+    lag = sim.nodes[-1]
+    strag = make_straggler_event(node, lag.pk, lag.sk, at_round=1)
+    inc = drive(
+        node.members, stake, node.config,
+        [events[i : i + 50] for i in range(0, len(events), 50)] + [[strag]],
+        block=64, chunk=32, window_bucket=256, prune_min=64,
+        fuse_chunks=8,
+    )
+    assert inc.full_rebases >= 1
+    packed = pack_events(events + [strag], node.members, stake)
+    assert_same_result(
+        inc.result(), run_consensus(packed, node.config, block=64)
+    )
+
+
+# -------------------------------------------------- decode overlap parity
+
+
+def test_async_decode_equals_sync_decode_digest():
+    """Worker-thread pre-decode vs synchronous decode: identical
+    consensus outputs AND identical archive blob digests (the spill
+    stream is a function of consensus state only, so thread scheduling
+    must not reorder or alter a single blob)."""
+    members, stake, events, _keys = generate_gossip_dag(
+        10, 1200, seed=2, n_forkers=2
+    )
+    chunks = random_chunks(events, 5)
+    kw = dict(chunk=64, window_bucket=512, prune_min=128, ingest_chunk=128)
+    a = drive(
+        members, stake,
+        SwirldConfig(n_members=10, decode_overlap=True), chunks, **kw
+    )
+    b = drive(
+        members, stake,
+        SwirldConfig(n_members=10, decode_overlap=False), chunks, **kw
+    )
+    assert a.decoded_off_thread > 0       # the worker actually decoded
+    assert b.decoded_off_thread == 0
+    assert_same_result(a.result(), b.result())
+    a.store.close()
+    b.store.close()
+    assert a.store.archive.digest() == b.store.archive.digest()
+
+
+class _PoisonEvent:
+    """Stand-in whose id computation fails on the decode worker."""
+
+    @property
+    def id(self):
+        raise RuntimeError("poison id")
+
+
+def test_decode_worker_failure_reraised_at_barrier():
+    """A failure inside the worker's prepare_events surfaces on the
+    ingest thread at the drain barrier (future.result()), not as a
+    swallowed exception or a hang."""
+    members, stake, events, _keys = generate_gossip_dag(8, 400, seed=6)
+    inc = StreamingConsensus(
+        members, stake,
+        SwirldConfig(n_members=8, decode_overlap=True, decode_queue_depth=2),
+        chunk=64, window_bucket=256, prune_min=64, ingest_chunk=64,
+    )
+    poisoned = events[:128] + [_PoisonEvent()]
+    with pytest.raises(RuntimeError, match="poison id"):
+        inc.ingest(poisoned)
+
+
+# ------------------------------------------------------- knob resolution
+
+
+def test_resolve_stream_settings_precedence(monkeypatch):
+    """fuse/decode knobs resolve field > env > default, and the ctor
+    kwarg wins over the config field for fuse_chunks."""
+    monkeypatch.delenv("SWIRLD_FUSE_CHUNKS", raising=False)
+    monkeypatch.delenv("SWIRLD_DECODE_OVERLAP", raising=False)
+    monkeypatch.delenv("SWIRLD_DECODE_QUEUE_DEPTH", raising=False)
+    s = resolve_stream_settings(SwirldConfig(n_members=4))
+    assert s == {
+        "fuse_chunks": 8, "decode_overlap": True, "decode_queue_depth": 2,
+    }
+    monkeypatch.setenv("SWIRLD_FUSE_CHUNKS", "3")
+    monkeypatch.setenv("SWIRLD_DECODE_OVERLAP", "0")
+    s = resolve_stream_settings(SwirldConfig(n_members=4))
+    assert s["fuse_chunks"] == 3 and s["decode_overlap"] is False
+    cfg = SwirldConfig(n_members=4, fuse_chunks=5, decode_overlap=True)
+    s = resolve_stream_settings(cfg)
+    assert s["fuse_chunks"] == 5 and s["decode_overlap"] is True
+    members, stake, _events, _keys = generate_gossip_dag(4, 8, seed=1)
+    inc = StreamingConsensus(members, stake, cfg, fuse_chunks=2)
+    assert inc._fuse == 2                 # explicit kwarg beats the field

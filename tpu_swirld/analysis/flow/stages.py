@@ -478,6 +478,30 @@ def _i_rounds_chunk(env):
     )
 
 
+def _i_rounds_span(env):
+    """Fused K-chunk rounds megadispatch: same carry/decl contract as
+    ``rounds_chunk_stage`` but the scan covers ``chunk * k_chunks``
+    events per dispatch, so the start scalar's bound tightens to
+    ``W - chunk*k`` (the driver only launches spans that fit the
+    window) and the interval proof must hold over the widest fused
+    trip count the default config can issue (k = 8)."""
+    from tpu_swirld.tpu import pipeline as P
+
+    d = _dims(env)
+    W, C, M, R, S, N = d["W"], d["C"], d["M"], d["R"], d["S"], d["N"]
+    k_chunks = min(8, max(1, W // d["chunk"]))
+    decls = _rounds_chunk_decls(
+        W, C, M, R, S, d["chunk"] * k_chunks, N - 1
+    )
+    decls[4] = _arr((M,), _I32, 0, d["smax"])
+    return (
+        P.rounds_span_stage,
+        dict(tot_stake=d["tot"], r_max=R, s_max=S, has_forks=True,
+             chunk=d["chunk"], k_chunks=k_chunks),
+        decls,
+    )
+
+
 def _i_fame(env):
     from tpu_swirld.tpu import pipeline as P
 
@@ -703,6 +727,8 @@ CATALOG: List[StageSpec] = [
               _INC, _i_ssm_update),
     StageSpec("inc.rounds_chunk", "pipeline.rounds_chunk_stage",
               _INC, _i_rounds_chunk),
+    StageSpec("inc.rounds_span", "pipeline.rounds_span_stage",
+              _INC, _i_rounds_span),
     StageSpec("inc.fame", "pipeline.inc_fame", _INC, _i_fame),
     StageSpec("inc.order", "pipeline.inc_order", _INC, _i_order),
     StageSpec("inc.compact_cols", "pipeline.inc_compact_cols",

@@ -242,12 +242,20 @@ def runtime_audit(
 
     steady = obslib.compile_counts(o.registry)
     drift = _find_drift(records)
+    # the fused rounds span (stage_call_fused megadispatch) feeds the
+    # same observer seam as stage_call, so when fuse_chunks > 1 (the
+    # resolved default) the audit's recompile/drift verdict covers the
+    # K-chunk scan path — surface that coverage in the report so a
+    # config that silently fell back to per-chunk dispatch is visible
+    fused_audited = "pipeline.rounds_span_stage" in records
     return {
         "engine": engine,
         "stages_observed": sorted(records),
         "steady_calls": {k: len(v) for k, v in sorted(records.items())},
         "steady_compiles": steady,
         "signature_drift": drift,
+        "fused_span_audited": fused_audited,
+        "fuse_chunks": inc._fuse,
         "ok": not steady and not drift,
     }
 
@@ -293,6 +301,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if "runtime" in report:
             rt = report["runtime"]
             print(f"stages observed: {len(rt['stages_observed'])}")
+            print(f"fused span audited: {rt['fused_span_audited']} "
+                  f"(fuse_chunks={rt['fuse_chunks']})")
             print(f"steady-state compiles: {rt['steady_compiles'] or 'none'}")
             for d in rt["signature_drift"]:
                 print(f"drift in {d['stage']}: {d['variants']}")

@@ -202,6 +202,20 @@ def stage_call(name: str, fn, *args, **kw):
     that's the point (per-stage attribution); leave it disabled for
     maximum-overlap production runs.
     """
+    return _stage_call(name, 1, fn, args, kw)
+
+
+def stage_call_fused(name: str, fused_chunks: int, fn, *args, **kw):
+    """:func:`stage_call` for a megadispatch covering ``fused_chunks``
+    packed scan chunks (the fused rounds span): identical tracing and
+    compile/execute classification, but the dispatch profiler is told
+    the dispatch amortizes over ``fused_chunks`` chunks so the single
+    inter-dispatch gap is attributed per chunk (gap / K) instead of
+    making the gap distribution look artificially clean."""
+    return _stage_call(name, max(1, int(fused_chunks)), fn, args, kw)
+
+
+def _stage_call(name: str, fused_chunks: int, fn, args, kw):
     so = _stage_observer
     if so is not None:
         so(name, fn, args, kw)
@@ -226,7 +240,9 @@ def stage_call(name: str, fn, *args, **kw):
     reg.counter("pipeline_stage_calls", {"stage": name, "kind": kind}).inc()
     if o.profiler is not None and kind == "execute":
         # compiles are one-time cost, not steady-state dispatch overhead
-        o.profiler.record_dispatch(name, t0, t1, args=args)
+        o.profiler.record_dispatch(
+            name, t0, t1, args=args, fused_chunks=fused_chunks
+        )
     return out
 
 

@@ -73,11 +73,19 @@ class DispatchProfiler:
         self._stage_s: Dict[str, float] = {}
         self._stage_calls: Dict[str, int] = {}
         self.dispatches = 0
+        self.fused_dispatches = 0     # dispatches covering >1 scan chunk
+        self.fused_chunks_total = 0   # scan chunks covered by those
         self.stage_s_total = 0.0
         self.gap_s_total = 0.0
         self.h2d_bytes = 0
         self.d2h_bytes = 0
         self.chunks: List[Dict] = []
+        # per-chunk *effective* gap samples: a K-chunk megadispatch's one
+        # inter-dispatch gap amortizes over K chunk boundaries, so it
+        # contributes K samples of gap/K — without this, fusing makes the
+        # gap distribution look artificially clean (one giant dispatch
+        # instead of K per-chunk ones)
+        self._gap_samples: List[float] = []
         self._last_end: Optional[float] = None
         self._chunk: Optional[Dict] = None
 
@@ -89,7 +97,7 @@ class DispatchProfiler:
             "label": label if label is not None else len(self.chunks),
             "t0": self._clock(),
             "stage_s": 0.0, "dispatches": 0, "gap_s": 0.0,
-            "h2d_bytes": 0, "d2h_bytes": 0,
+            "fused_chunks": 0, "h2d_bytes": 0, "d2h_bytes": 0,
         }
         # gaps never span a chunk boundary: the wait between chunks is
         # the caller's (data generation), not dispatch overhead
@@ -114,12 +122,20 @@ class DispatchProfiler:
     # ----------------------------------------------------------- recording
 
     def record_dispatch(self, stage: str, t0: float, t1: float,
-                        args=()) -> None:
+                        args=(), fused_chunks: int = 1) -> None:
         """One ``stage_call`` completed: ``t0``/``t1`` are its start/end
         on the caller's monotonic clock; ``args`` are the stage's
-        positional arguments (scanned for host arrays — H2D bytes)."""
+        positional arguments (scanned for host arrays — H2D bytes).
+        ``fused_chunks > 1`` marks a megadispatch whose single
+        inter-dispatch gap amortizes over that many scan chunks: the gap
+        contributes ``fused_chunks`` effective samples of ``gap / K`` so
+        per-chunk gap statistics stay comparable across fusion levels."""
         dt = max(0.0, t1 - t0)
+        k = max(1, int(fused_chunks))
         self.dispatches += 1
+        if k > 1:
+            self.fused_dispatches += 1
+            self.fused_chunks_total += k
         self.stage_s_total += dt
         self._stage_s[stage] = self._stage_s.get(stage, 0.0) + dt
         self._stage_calls[stage] = self._stage_calls.get(stage, 0) + 1
@@ -127,6 +143,7 @@ class DispatchProfiler:
         if self._last_end is not None:
             gap = max(0.0, t0 - self._last_end)
             self.gap_s_total += gap
+            self._gap_samples.extend([gap / k] * k)
         self._last_end = t1
         h2d = _host_arg_bytes(args)
         self.h2d_bytes += h2d
@@ -136,6 +153,8 @@ class DispatchProfiler:
             c["dispatches"] += 1
             c["gap_s"] += gap
             c["h2d_bytes"] += h2d
+            if k > 1:
+                c["fused_chunks"] += k
 
     def record_transfer(self, direction: str, nbytes: int) -> None:
         """An explicit host↔device copy outside dispatch args
@@ -168,6 +187,21 @@ class DispatchProfiler:
             for s in ranked[:k]
         ]
 
+    def gap_quantiles(self) -> Dict:
+        """Per-chunk *effective* inter-dispatch gap distribution (p50 /
+        p99 / max, seconds).  Fused dispatches contribute K samples of
+        ``gap / K`` each, so the quantiles compare across fusion levels."""
+        g = self._gap_samples
+        if not g:
+            return {"p50": 0.0, "p99": 0.0, "max": 0.0}
+        s = sorted(g)
+        q = lambda p: s[min(len(s) - 1, int(p * (len(s) - 1) + 0.5))]  # noqa: E731
+        return {
+            "p50": round(q(0.50), 6),
+            "p99": round(q(0.99), 6),
+            "max": round(s[-1], 6),
+        }
+
     def summary(self) -> Dict:
         """The ``bench.py --stream`` dispatch-breakdown object."""
         wall = sum(c["wall_s"] for c in self.chunks)
@@ -175,10 +209,13 @@ class DispatchProfiler:
         return {
             "chunks": len(self.chunks),
             "dispatches": self.dispatches,
+            "fused_dispatches": self.fused_dispatches,
+            "fused_chunks": self.fused_chunks_total,
             "wall_s": round(wall, 6),
             "stage_s": round(self.stage_s_total, 6),
             "dispatch_overhead_s": round(overhead, 6),
             "gap_s": round(self.gap_s_total, 6),
+            "gap_per_chunk": self.gap_quantiles(),
             "transfers_bytes": {
                 "h2d": self.h2d_bytes, "d2h": self.d2h_bytes,
             },

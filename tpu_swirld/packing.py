@@ -133,7 +133,13 @@ class Packer:
 
     def append(self, ev: Event) -> int:
         """Pack one event (parents must already be packed).  Idempotent."""
-        eid = ev.id
+        return self.append_prepared(ev, ev.id)
+
+    def append_prepared(self, ev: Event, eid: bytes) -> int:
+        """:meth:`append` with the event id already computed — the
+        decode-overlap worker hashes ids off-thread (``prepare_events``)
+        and the main thread packs here without re-hashing.  All packer
+        mutation stays on the calling thread."""
         existing = self.idx.get(eid)
         if existing is not None:
             return existing
@@ -157,7 +163,6 @@ class Packer:
         self._seq[i] = seq
         self._t[i] = int(ev.t)
         self._coin[i] = ev.coin_bit() & 1
-        self._n = i + 1
         self._ids.append(eid)
         self._sigs.append(ev.s)
         slot = int(self._member_counts[ci])
@@ -168,10 +173,20 @@ class Packer:
         for other in group:            # every prior same-(creator, seq) event
             self._push_fork_pair((ci, other, i))
         group.append(i)
+        # publish last: every row/side-table write above used the local
+        # index, so a concurrent len()/pack() reader (telemetry, the
+        # decode-overlap driver's invariant checks) never observes a
+        # half-written event at position _n - 1
+        self._n = i + 1
         return i
 
     def extend(self, events: Iterable[Event]) -> List[int]:
         return [self.append(ev) for ev in events]
+
+    def extend_prepared(self, pairs: Iterable[Tuple[Event, bytes]]) -> List[int]:
+        """Pack a pre-decoded delta: ``pairs`` as produced by
+        :func:`prepare_events` (typically on a worker thread)."""
+        return [self.append_prepared(ev, eid) for ev, eid in pairs]
 
     # ---- bounded read-only views (the incremental driver's surface:
     # keeps the buffer layout private to this file; same freeze contract
@@ -248,6 +263,16 @@ def chunk_slices(n: int, chunk: int) -> List[Tuple[int, int]]:
     if chunk <= 0:
         raise ValueError("chunk must be positive")
     return [(s, min(n, s + chunk)) for s in range(0, n, chunk)]
+
+
+def prepare_events(events: Sequence[Event]) -> List[Tuple[Event, bytes]]:
+    """Gossip decode for a delta: compute each event's id (a content
+    hash — the dominant host cost of packing) without touching any
+    shared state.  Pure function of the events, so it can run on the
+    streaming driver's decode worker while the device executes the
+    previous chunk; the main thread packs the result with
+    :meth:`Packer.extend_prepared`."""
+    return [(ev, ev.id) for ev in events]
 
 
 def pack_events(

@@ -395,6 +395,7 @@ class MeshStreamingConsensus(StreamingConsensus):
         strict_budget: bool = False,
         store: Optional[SlabStore] = None,
         bmm=None,
+        pallas: bool = False,
         **kw,
     ):
         self.mesh = mesh
@@ -410,7 +411,15 @@ class MeshStreamingConsensus(StreamingConsensus):
             "slab_put",
             lambda x: jax.device_put(np.asarray(x), self._nsh),
         )
-        kernel = make_row_sharded_block_fn(mesh, bmm=bmm)
+        if pallas and bmm is None:
+            # the Pallas MXU hop inside the same halo/psum pairing;
+            # interpret-vs-compiled resolves via the capability probe
+            # (compiled on TPU/GPU, interpret elsewhere — bit-identical)
+            from tpu_swirld.tpu.pallas_kernels import make_mesh_row_block_fn
+
+            kernel = make_mesh_row_block_fn(mesh)
+        else:
+            kernel = make_row_sharded_block_fn(mesh, bmm=bmm)
         kw.setdefault(
             "ssm_block_fn",
             functools.partial(

@@ -45,6 +45,13 @@ operation rather than swallowed.  ``async_spill=False`` (or
 ``SWIRLD_ARCHIVE_ASYNC=0``) degrades to the fully synchronous behavior —
 bit-identical output either way.
 
+The streaming driver's **decode-overlap** worker
+(:meth:`tpu_swirld.store.streaming.StreamingConsensus._chunked_deltas`)
+is this protocol's ingest-side mirror: a bounded queue of pure
+`prepare_events` jobs ahead of the device, a drain barrier at every
+handoff (which re-raises worker failures), and a sync fallback that is
+bit-identical by construction.  Audit changes to either against both.
+
 Rows decompressed for parent-prefix reconstruction or fetches are kept in
 a bounded LRU cache (parents of spilled rows are almost always recent, so
 the hit rate is high), and :meth:`prefetch` warms that cache in the

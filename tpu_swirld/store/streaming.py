@@ -42,7 +42,9 @@ sees are pure DAG functions, so archived rows equal recomputed rows.
 
 from __future__ import annotations
 
+import collections
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -50,7 +52,8 @@ import numpy as np
 import jax.numpy as jnp
 
 from tpu_swirld import obs
-from tpu_swirld.packing import chunk_slices
+from tpu_swirld.config import resolve_stream_settings
+from tpu_swirld.packing import chunk_slices, prepare_events
 from tpu_swirld.store.slab import SlabStore
 from tpu_swirld.tpu.pipeline import (
     IncrementalConsensus,
@@ -96,6 +99,18 @@ class StreamingConsensus(IncrementalConsensus):
             )
         )
         self._ingest_chunk = _bucket(max(ingest_chunk, 1), self._chunk)
+        # decode overlap: pre-hash the NEXT ingest chunk's event ids on a
+        # worker thread while the device executes the current one.
+        # Results are bit-identical either way — the worker computes a
+        # pure function (prepare_events) and every handoff goes through a
+        # drain barrier (future.result(), which also re-raises worker
+        # failures); all packer mutation stays on the ingest thread.
+        _ss = resolve_stream_settings(self.config)
+        self._decode_overlap = bool(_ss["decode_overlap"])
+        self._decode_depth = max(1, int(_ss["decode_queue_depth"]))
+        self._staged: Optional[List] = None  # pre-decoded next chunk
+        self.decoded_off_thread = 0          # observability: events decoded
+                                             # on the worker
         self._round_hi = 0          # next global round to ledger-retire
         self._widen_answered = False
         self.flightrec_label = "streaming"
@@ -124,8 +139,8 @@ class StreamingConsensus(IncrementalConsensus):
         else:
             merged: Optional[Dict] = None
             n_chunks = 0
-            for s, e in chunk_slices(len(events), self._ingest_chunk):
-                st = super().ingest(events[s:e])
+            for chunk_ev in self._chunked_deltas(events):
+                st = super().ingest(chunk_ev)
                 n_chunks += 1
                 if merged is None:
                     merged = st
@@ -152,6 +167,8 @@ class StreamingConsensus(IncrementalConsensus):
         self._account()
         arch = self.store.archive
         st["ingest_chunks"] = n_chunks
+        st["fuse_chunks"] = self._fuse
+        st["decode_overlap"] = self._decode_overlap
         st["resident_bytes"] = self.resident_visibility_bytes
         st["archived_rows"] = arch.n_rows
         st["overlap_ratio"] = round(overlap, 4)
@@ -162,6 +179,59 @@ class StreamingConsensus(IncrementalConsensus):
             g.gauge("stream_overlap_ratio").set(st["overlap_ratio"])
             g.gauge("store_spill_queue_depth").set(st["spill_queue_depth"])
         return st
+
+    # ----------------------------------------------------- decode overlap
+
+    def _chunked_deltas(self, events: List):
+        """Yield the delta's ingest chunks in order.  With decode overlap
+        on, one worker thread runs :func:`~tpu_swirld.packing.
+        prepare_events` (event-id hashing — the dominant host decode
+        cost) up to ``decode_queue_depth`` chunks ahead of the chunk the
+        device is executing.  Each yield first drains the worker's future
+        for that chunk (``future.result()`` — the barrier that also
+        re-raises any worker failure on the ingest thread) and stages the
+        pre-decoded pairs for :meth:`_pack_delta`; the worker never
+        touches the packer or any driver state, so async and sync
+        ingestion are bit-identical by construction."""
+        slices = chunk_slices(len(events), self._ingest_chunk)
+        if not (self._decode_overlap and len(slices) > 1):
+            for s, e in slices:
+                yield events[s:e]
+            return
+        with ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="swirld-decode"
+        ) as ex:
+            futs = collections.deque()
+            it = iter(slices)
+
+            def submit_next():
+                nxt = next(it, None)
+                if nxt is not None:
+                    futs.append(
+                        ex.submit(prepare_events, events[nxt[0]:nxt[1]])
+                    )
+
+            for _ in range(min(self._decode_depth, len(slices))):
+                submit_next()
+            while futs:
+                pairs = futs.popleft().result()   # drain barrier
+                submit_next()                     # keep the queue full
+                self._staged = pairs
+                self.decoded_off_thread += len(pairs)
+                try:
+                    yield [ev for ev, _ in pairs]
+                finally:
+                    self._staged = None
+
+    def _pack_delta(self, events) -> None:
+        # consume the staged pre-decode when it matches this delta; any
+        # other path (rebase replays, direct super().ingest calls, the
+        # sync fallback) packs — and hashes — on this thread as before
+        staged, self._staged = self._staged, None
+        if staged is not None and len(staged) == len(events):
+            self.packer.extend_prepared(staged)
+        else:
+            super()._pack_delta(events)
 
     def _account(self) -> None:
         if not self._initialized:

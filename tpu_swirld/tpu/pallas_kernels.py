@@ -40,6 +40,7 @@ initialize this round).
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import numpy as np
 
@@ -47,6 +48,26 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+
+@functools.lru_cache(maxsize=None)
+def accel_compiled() -> bool:
+    """True when the default backend lowers Pallas kernels natively
+    (TPU via Mosaic, GPU via Triton).  CPU has no native lowering and
+    runs interpret mode — bit-identical, per the parity pin of
+    ``tests/test_pallas.py``."""
+    return jax.default_backend() in ("tpu", "gpu")
+
+
+def resolve_interpret(interpret: Optional[bool] = None) -> bool:
+    """The capability probe behind every kernel factory's
+    ``interpret=None`` default: an explicit True/False wins; None
+    compiles on TPU/GPU and falls back to interpret mode on CPU, so the
+    same driver construction runs the compiled kernels wherever the
+    hardware can and stays exact everywhere else."""
+    if interpret is None:
+        return not accel_compiled()
+    return bool(interpret)
 
 # renamed TPUCompilerParams -> CompilerParams across JAX releases
 _CompilerParams = getattr(
@@ -91,11 +112,13 @@ def ssm_matrix_pallas(
     *,
     tile_m: int = 256,
     tile_n: int = 256,
-    interpret: bool = False,
+    interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Strongly-sees (∃-z rule) as a single Pallas kernel.  Drop-in
     replacement for :func:`tpu_swirld.tpu.pipeline.ssm_matrix` (pass via
-    ``run_consensus(..., use_pallas_ssm=True)``)."""
+    ``run_consensus(..., use_pallas_ssm=True)``).  ``interpret=None``
+    resolves via :func:`resolve_interpret` (compiled on TPU/GPU)."""
+    interpret = resolve_interpret(interpret)
     n = sees.shape[0]
     n_members, k = member_table.shape
     tile_m = _fit_tile(tile_m, n)
@@ -149,9 +172,10 @@ def ssm_matrix_pallas(
     )(stake.astype(jnp.int32), a, b)
 
 
-def make_ssm_fn(*, interpret: bool = False, tile_m: int = 256,
+def make_ssm_fn(*, interpret: Optional[bool] = None, tile_m: int = 256,
                 tile_n: int = 256):
     """Adapter matching the ``ssm_fn`` seam of ``rounds_body``."""
+    interpret = resolve_interpret(interpret)
 
     def ssm_fn(sees, member_table, stake, tot_stake, dtype):
         return ssm_matrix_pallas(
@@ -182,7 +206,7 @@ def _fit_tile(t: int, n: int) -> int:
 def ssm_block_pallas(sees, member_table, stake, cols, row0, *, rows,
                      tot_stake, matmul_dtype_name,
                      tile_m: int = 256, tile_n: int = 128,
-                     interpret: bool = False):
+                     interpret: Optional[bool] = None):
     """Strongly-sees *block* for window rows ``[row0, row0 + rows)`` ×
     column events ``cols`` as one Pallas kernel — the windowed
     counterpart of :func:`ssm_matrix_pallas`, matching the
@@ -196,6 +220,7 @@ def ssm_block_pallas(sees, member_table, stake, cols, row0, *, rows,
     exactly as the full-matrix kernel does — the int32 tally never
     touches HBM.
     """
+    interpret = resolve_interpret(interpret)   # static: resolved at trace
     matmul_dtype = (
         jnp.bfloat16 if matmul_dtype_name == "bfloat16" else jnp.float32
     )
@@ -259,12 +284,13 @@ def ssm_block_pallas(sees, member_table, stake, cols, row0, *, rows,
     return out & col_valid[None, :]
 
 
-def make_ssm_block_fn(*, interpret: bool = False, tile_m: int = 256,
-                      tile_n: int = 128):
+def make_ssm_block_fn(*, interpret: Optional[bool] = None,
+                      tile_m: int = 256, tile_n: int = 128):
     """Adapter matching the ``ssm_block_fn`` seam of the incremental /
     streaming drivers (:class:`tpu_swirld.tpu.pipeline.
     IncrementalConsensus`) and of :func:`tpu_swirld.tpu.pipeline.
     _columns_pass`."""
+    interpret = resolve_interpret(interpret)
 
     def ssm_block_fn(sees, member_table, stake, cols, row0, *, rows,
                      tot_stake, matmul_dtype_name):
@@ -285,12 +311,13 @@ def _bmm_kernel(a_ref, b_ref, out_ref):
 
 
 def bmm_or_pallas(a, b, matmul_dtype, *, tile_m: int = 128,
-                  tile_n: int = 256, interpret: bool = False):
+                  tile_n: int = 256, interpret: Optional[bool] = None):
     """Tiled boolean matmul (OR over 0/1 products) as a Pallas kernel —
     the MXU hop of the blockwise ancestry extension (``ExtensionKernels.
     bmm``).  The contraction axis (one event block) rides whole into
     VMEM; the output grid is ``(P/Tm, R/Tn)``.  Exact: 0/1 products with
     f32 accumulation, thresholded at 0.5."""
+    interpret = resolve_interpret(interpret)
     p, q = a.shape
     r = b.shape[1]
     try:
@@ -338,7 +365,7 @@ def bmm_or_pallas(a, b, matmul_dtype, *, tile_m: int = 128,
     )(am, bm)
 
 
-def make_mesh_row_block_fn(mesh, *, interpret: bool = False):
+def make_mesh_row_block_fn(mesh, *, interpret: Optional[bool] = None):
     """The row-sharded streaming block kernel
     (:func:`tpu_swirld.parallel.make_row_sharded_block_fn`) with
     :func:`bmm_or_pallas` as the shard-local matmul hop: the halo
@@ -348,14 +375,16 @@ def make_mesh_row_block_fn(mesh, *, interpret: bool = False):
     (0/1 products, f32 accumulation, shared threshold)."""
     from tpu_swirld.parallel import make_row_sharded_block_fn
 
+    interpret = resolve_interpret(interpret)
+
     def bmm(a, b, dtype):
         return bmm_or_pallas(a, b, dtype, interpret=interpret)
 
     return make_row_sharded_block_fn(mesh, bmm=bmm)
 
 
-def make_extension_kernels(*, interpret: bool = False, tile_m: int = 256,
-                           tile_n: int = 128):
+def make_extension_kernels(*, interpret: Optional[bool] = None,
+                           tile_m: int = 256, tile_n: int = 128):
     """The Pallas :class:`~tpu_swirld.tpu.pipeline.ExtensionKernels`
     bundle for the window-extension hot path: the blockwise ancestry
     boolean-matmul hop and the strongly-sees block kernel, both consuming
@@ -363,6 +392,8 @@ def make_extension_kernels(*, interpret: bool = False, tile_m: int = 256,
     kernels bit-identically off-TPU (the parity pin of
     ``tests/test_pallas.py``)."""
     from tpu_swirld.tpu.pipeline import ExtensionKernels
+
+    interpret = resolve_interpret(interpret)
 
     def bmm(a, b, dtype):
         return bmm_or_pallas(a, b, dtype, interpret=interpret)

@@ -75,7 +75,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from tpu_swirld import crypto, obs
-from tpu_swirld.config import SwirldConfig
+from tpu_swirld.config import SwirldConfig, resolve_stream_settings
 from tpu_swirld.oracle.node import xor_bytes
 from tpu_swirld.packing import PackedDAG, Packer
 
@@ -941,6 +941,34 @@ def rounds_chunk_stage(parents, ssm_c, col_pos, creator, stake, n_valid,
 @functools.partial(
     jax.jit,
     static_argnames=(
+        "tot_stake", "r_max", "s_max", "has_forks", "chunk", "k_chunks",
+    ),
+    donate_argnums=(6, 7, 8, 9, 10),
+)
+def rounds_span_stage(parents, ssm_c, col_pos, creator, stake, n_valid,
+                      rnd, wits, tab, cnt, overflow, start, r_base, *,
+                      tot_stake, r_max, s_max, has_forks, chunk, k_chunks):
+    """``k_chunks`` packed chunks of the rounds scan in ONE dispatch —
+    the fused megakernel.  Same per-event body as rounds_chunk_stage,
+    scan length ``chunk * k_chunks`` (one compiled body either way; the
+    trip count is static).  The carry slabs (rnd/wits/tab/cnt/overflow,
+    positions 6-10) are donated: callers re-upload the host-mirror carry
+    before every probe, so the witness-column fixpoint retry never reads
+    a buffer this dispatch consumed."""
+    step = _make_rounds_step(
+        parents, ssm_c, creator, stake, tot_stake, n_valid, r_base,
+        r_max=r_max, s_max=s_max, has_forks=has_forks, col_pos=col_pos,
+    )
+    carry0 = (rnd, wits, tab, cnt, overflow)
+    (rnd, wits, tab, cnt, overflow), _ = lax.scan(
+        step, carry0, start + jnp.arange(chunk * k_chunks)
+    )
+    return rnd, wits, tab, cnt, overflow
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
         "tot_stake", "coin_period", "r_max", "s_max", "chain", "has_forks",
         "matmul_dtype_name",
     ),
@@ -1267,9 +1295,9 @@ def run_consensus(
         )
     stage_a_fn = rounds_stage
     if use_pallas_ssm:
-        stage_a_fn = rounds_stage_pallas(
-            interpret=jax.default_backend() != "tpu"
-        )
+        from tpu_swirld.tpu.pallas_kernels import resolve_interpret
+
+        stage_a_fn = rounds_stage_pallas(interpret=resolve_interpret())
     t_dev0 = time.perf_counter()
     retries = 0
     while True:
@@ -1974,6 +2002,7 @@ class IncrementalConsensus:
         storm_threshold: int = 3,
         storm_cooldown: int = 8,
         slab_put=None,
+        fuse_chunks: Optional[int] = None,
     ):
         if stake is None:
             stake = [1] * len(members)
@@ -1981,6 +2010,13 @@ class IncrementalConsensus:
         self.config = config or SwirldConfig(n_members=len(members))
         self._block = block
         self._chunk = max(32, chunk)
+        # dispatch fusion: how many rounds-scan chunks one device
+        # dispatch covers (rounds_span_stage).  <= 1 keeps the original
+        # per-chunk loop; resolution order is explicit kwarg > config
+        # field > SWIRLD_FUSE_CHUNKS env > default (see config module)
+        if fuse_chunks is None:
+            fuse_chunks = resolve_stream_settings(self.config)["fuse_chunks"]
+        self._fuse = max(1, int(fuse_chunks))
         self._window_bucket = max(256, window_bucket)
         self._prune_min = (
             prune_min if prune_min is not None else self._window_bucket // 4
@@ -2148,6 +2184,13 @@ class IncrementalConsensus:
         """A batch rebase decided everything up to the new ``self._lo``;
         ``aux`` still holds the full-DAG device slabs."""
 
+    def _pack_delta(self, events) -> None:
+        """Append a gossip delta to the packer.  Seam for the streaming
+        driver's decode-overlap path, which substitutes pre-decoded
+        ``(event, id)`` pairs produced on a worker thread — the override
+        must keep all packer mutation on the calling thread."""
+        self.packer.extend(events)
+
     def ingest(self, events=()) -> Dict:
         """Feed a topo-ordered gossip delta; run one incremental pass.
 
@@ -2162,7 +2205,7 @@ class IncrementalConsensus:
             # return path yields a dispatch-overhead breakdown row
             _o.profiler.begin_chunk()
         n_before = len(self.packer)
-        self.packer.extend(events)
+        self._pack_delta(events)
         n_total = len(self.packer)
         if self.finality is not None and n_total > n_before:
             # birth = the tick this ingest chunk entered the driver; the
@@ -2518,6 +2561,79 @@ class IncrementalConsensus:
 
     # ------------------------------------------------------- extend pass
 
+    def _rounds_span_fixpoint(self, parents_d, creator_d, stake_d, n_valid,
+                              has_forks, w0, n_pad_new, r_base_d):
+        """Fused rounds scan: spans of up to ``self._fuse`` chunks per
+        dispatch (``rounds_span_stage``), each run to a witness-column
+        fixpoint.  Returns the accepted final carry (device tuple, same
+        layout as the unfused loop's ``state``) or ``None`` on round/slot
+        overflow — the caller rebases, which is exact because the unfused
+        path also commits nothing once its sticky overflow bit is set.
+
+        Exactness vs the per-chunk loop: every probe re-runs the whole
+        span from the SAME host-mirror carry, and a probe is accepted
+        only when every witness registered anywhere in its output table
+        already had a strongly-sees column for the entire run.  A missing
+        column deterministically reads as not-strongly-seen (the scan
+        body masks ``col_pos < 0`` — under-promotion only, never
+        garbage), so an accepted run never consumed a value the
+        fully-informed run wouldn't produce; its outputs are therefore
+        bit-identical to running the chunks one dispatch at a time.
+        Each failed probe registers >= 1 event whose column is absent
+        and ``_add_columns`` makes it present, so columns grow strictly
+        monotonically and the loop terminates within span_len probes.
+        A ragged tail (n_chunks % fuse != 0) gets its own static
+        ``k_chunks`` — a session-bounded shape family (< fuse values).
+        """
+        chunk = self._chunk
+        n_chunks = n_pad_new // chunk
+        # host-side carry: every probe uploads fresh device buffers from
+        # these, so the donated span stage (carry positions 6-10) never
+        # consumes a buffer the retry loop still needs
+        carry_h = (self._rnd_w, self._wits_w, self._tab_np, self._cnt_np)
+        state = None
+        ci = 0
+        while ci < n_chunks:
+            k = min(self._fuse, n_chunks - ci)
+            start = np.int32(w0 + ci * chunk)
+            span_len = k * chunk
+            for _attempt in range(span_len + 1):
+                out = obs.stage_call_fused(
+                    "pipeline.rounds_span_stage", k, rounds_span_stage,
+                    parents_d, self._ssm_d, jnp.asarray(self._colpos_w),
+                    creator_d, stake_d, np.int32(n_valid),
+                    jnp.asarray(carry_h[0]), jnp.asarray(carry_h[1]),
+                    jnp.asarray(carry_h[2]), jnp.asarray(carry_h[3]),
+                    jnp.zeros((), dtype=jnp.int32), start, r_base_d,
+                    tot_stake=self._tot, r_max=self._r_cap,
+                    s_max=self._s_cap, has_forks=has_forks,
+                    chunk=chunk, k_chunks=k,
+                )
+                tab = obs.to_host(out[2])
+                registered = np.unique(tab[tab >= 0])
+                missing = registered[self._colpos_w[registered] < 0]
+                if missing.size == 0:
+                    state = out
+                    break
+                self._add_columns([int(e) for e in missing])
+            else:
+                raise RuntimeError("witness-column span did not converge")
+            if int(obs.to_host(state[4])):
+                return None
+            ci += k
+            if ci < n_chunks:
+                # next span resumes from this span's accepted carry; pull
+                # it to host ONCE per span (copy=True: an owned host
+                # array, never a zero-copy view of the device buffer the
+                # next probe would donate)
+                carry_h = (
+                    obs.to_host(state[0], copy=True),
+                    obs.to_host(state[1], copy=True),
+                    obs.to_host(state[2], copy=True),
+                    obs.to_host(state[3], copy=True),
+                )
+        return state
+
     def _extend_pass(self, n_new: int) -> Tuple[List[int], bool]:
         """One incremental pass over the ``n_new`` freshly packed events.
         Returns ``(newly_ordered, need_rebase)``."""
@@ -2654,54 +2770,67 @@ class IncrementalConsensus:
         self._rows_hi = w0 + n_pad_new
 
         # ---- resumed rounds scan over the new events only
-        state = (
-            jnp.asarray(self._rnd_w),
-            jnp.asarray(self._wits_w),
-            jnp.asarray(self._tab_np),
-            jnp.asarray(self._cnt_np),
-            jnp.zeros((), dtype=jnp.int32),
-        )
         r_base_d = np.int32(self._r_base)
-        for start in range(w0, w0 + n_pad_new, chunk):
-            for _attempt in range(chunk + 1):
-                out = obs.stage_call(
-                    "pipeline.rounds_chunk_stage", rounds_chunk_stage,
-                    parents_d, self._ssm_d, jnp.asarray(self._colpos_w),
-                    creator_d, stake_d, np.int32(n_valid), *state,
-                    np.int32(start), r_base_d,
-                    tot_stake=self._tot, r_max=self._r_cap,
-                    s_max=self._s_cap, has_forks=has_forks, chunk=chunk,
-                )
-                tab = obs.to_host(out[2])
-                registered = np.unique(tab[tab >= 0])
-                missing = registered[self._colpos_w[registered] < 0]
-                if missing.size == 0:
-                    state = out
-                    break
-                rnd_np = obs.to_host(out[0])
-                ce = np.arange(start, start + chunk, dtype=np.int64)
-                pc = self._parents_w[ce]
-                r0 = np.where(
-                    pc[:, 0] < 0,
-                    -1,
-                    np.maximum(rnd_np[np.maximum(pc[:, 0], 0)],
-                               rnd_np[np.maximum(pc[:, 1], 0)]),
-                )
-                affected = False
-                for w in missing:
-                    if w < start:
-                        affected = True
+        if self._fuse > 1:
+            state = self._rounds_span_fixpoint(
+                parents_d, creator_d, stake_d, n_valid, has_forks,
+                w0, n_pad_new, r_base_d,
+            )
+            if state is None:
+                # round/slot capacity overflow mid-span -> rebase now;
+                # the unfused path also commits nothing on overflow, so
+                # skipping the remaining spans is exact
+                return [], True
+        else:
+            state = (
+                jnp.asarray(self._rnd_w),
+                jnp.asarray(self._wits_w),
+                jnp.asarray(self._tab_np),
+                jnp.asarray(self._cnt_np),
+                jnp.zeros((), dtype=jnp.int32),
+            )
+            for start in range(w0, w0 + n_pad_new, chunk):
+                for _attempt in range(chunk + 1):
+                    out = obs.stage_call(
+                        "pipeline.rounds_chunk_stage", rounds_chunk_stage,
+                        parents_d, self._ssm_d, jnp.asarray(self._colpos_w),
+                        creator_d, stake_d, np.int32(n_valid), *state,
+                        np.int32(start), r_base_d,
+                        tot_stake=self._tot, r_max=self._r_cap,
+                        s_max=self._s_cap, has_forks=has_forks, chunk=chunk,
+                    )
+                    tab = obs.to_host(out[2])
+                    registered = np.unique(tab[tab >= 0])
+                    missing = registered[self._colpos_w[registered] < 0]
+                    if missing.size == 0:
+                        state = out
                         break
-                    later = ce > w
-                    if np.any(later & (r0 == rnd_np[w])):
-                        affected = True
+                    rnd_np = obs.to_host(out[0])
+                    ce = np.arange(start, start + chunk, dtype=np.int64)
+                    pc = self._parents_w[ce]
+                    r0 = np.where(
+                        pc[:, 0] < 0,
+                        -1,
+                        np.maximum(rnd_np[np.maximum(pc[:, 0], 0)],
+                                   rnd_np[np.maximum(pc[:, 1], 0)]),
+                    )
+                    affected = False
+                    for w in missing:
+                        if w < start:
+                            affected = True
+                            break
+                        later = ce > w
+                        if np.any(later & (r0 == rnd_np[w])):
+                            affected = True
+                            break
+                    self._add_columns([int(e) for e in missing])
+                    if not affected:
+                        state = out
                         break
-                self._add_columns([int(e) for e in missing])
-                if not affected:
-                    state = out
-                    break
-            else:
-                raise RuntimeError("witness-column chunk did not converge")
+                else:
+                    raise RuntimeError(
+                        "witness-column chunk did not converge"
+                    )
 
         # copy=True (np.array, not asarray): device pulls are read-only
         # views, and these mirrors are mutated in place by roll/prune
