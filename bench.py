@@ -1037,6 +1037,87 @@ def run_cluster():
         sys.exit(1)
 
 
+def run_soak():
+    """--soak: the composed production-day scenario as a gated bench.
+
+    One :func:`tpu_swirld.soak.run_soak` pass — BENCH_SOAK_NODES
+    processes through per-link TCP fault proxies, heavy-tailed traffic
+    from BENCH_SOAK_CLIENTS concurrent clients, and the smoke window
+    composition (1 SIGKILL crash + WAL recovery, 1 partition/heal, 1
+    byzantine equivocation storm) scaled to BENCH_SOAK_HORIZON — and one
+    JSON line with ``soak.{tx_per_s, submit_p99_s,
+    disruptions_survived, verdict_ok}`` for bench_compare.py to gate.
+    Exit 1 on a red composite verdict.
+
+    Env knobs: BENCH_SOAK_NODES (4), BENCH_SOAK_HORIZON (8.0 s),
+    BENCH_SOAK_RATE (150 tx/s), BENCH_SOAK_CLIENTS (3),
+    BENCH_SOAK_SEED (3).
+    """
+    import dataclasses
+    import tempfile
+
+    from tpu_swirld import soak as _soak
+
+    n_nodes = int(os.environ.get("BENCH_SOAK_NODES", "4"))
+    horizon = float(os.environ.get("BENCH_SOAK_HORIZON", "8.0"))
+    rate = float(os.environ.get("BENCH_SOAK_RATE", "150"))
+    clients = int(os.environ.get("BENCH_SOAK_CLIENTS", "3"))
+    seed = int(os.environ.get("BENCH_SOAK_SEED", "3"))
+
+    workdir = tempfile.mkdtemp(prefix="swirld-bench-soak-")
+    log(f"[soak] {n_nodes} processes through per-link fault proxies, "
+        f"{horizon}s @ {rate} tx/s from {clients} clients; "
+        f"crash + partition + equivocation storm ({workdir})")
+    spec = _soak.default_spec(
+        workdir, n_nodes=n_nodes, seed=seed, horizon_s=horizon,
+        tx_rate=rate, n_clients=clients,
+        net={"gossip_interval_s": 0.005, "checkpoint_every_s": 0.5},
+    )
+    spec = dataclasses.replace(spec, schedule=_soak.smoke_schedule(spec))
+    verdict = _soak.run_soak(spec)
+    log(f"[soak] ok={verdict['ok']} "
+        f"survived={verdict['disruptions_survived']}"
+        f"/{verdict['disruptions_total']} "
+        f"tx/s={verdict['tx_per_s']:.0f} "
+        f"submit_p99={verdict['submit_p99_s']:.3f}s "
+        f"equivocations={verdict['adversary']['equivocations_detected']}")
+
+    out = {
+        "metric": "soak_tx_per_s",
+        "value": verdict["tx_per_s"],
+        "unit": "acked tx/sec under composed faults",
+        "platform": "cpu-processes",
+        "soak": {
+            "tx_per_s": verdict["tx_per_s"],
+            "submit_p99_s": verdict["submit_p99_s"],
+            "disruptions_survived": verdict["disruptions_survived"],
+            "disruptions_total": verdict["disruptions_total"],
+            "verdict_ok": verdict["ok"],
+            "safety": verdict["safety"],
+            "finality": verdict["finality"],
+            "accounting_balance_ok":
+                verdict["accounting"].get("balance_ok"),
+            "shed_rate": verdict["accounting"].get("shed_rate"),
+            "net_redials": verdict["counters"]["net_redials"],
+            "equivocations_detected":
+                verdict["adversary"]["equivocations_detected"],
+            "proxy_relayed": verdict["proxy"].get("relayed", 0),
+            "proxy_partition_blocked":
+                verdict["proxy"].get("partition_blocked", 0),
+            "n_nodes": n_nodes,
+            "horizon_s": horizon,
+            "rate": rate,
+        },
+        "lint": lint_stamp(),
+        "mc": mc_stamp(),
+        "scale_audit": scale_audit_stamp(),
+    }
+    print(json.dumps(out), flush=True)
+    if not verdict["ok"]:
+        log("[soak] FAIL: composite verdict not green")
+        sys.exit(1)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument(
@@ -1080,8 +1161,18 @@ def main(argv=None):
         "(BENCH_CLUSTER_* overrides); also runs an overload leg that "
         "must shed load (exit 1 on any verdict failure or zero sheds)",
     )
+    ap.add_argument(
+        "--soak", action="store_true",
+        help="run the composed production-day soak (per-link TCP fault "
+        "proxies, heavy-tailed traffic, crash + partition + equivocation "
+        "storm windows) and stamp acked tx/s, client-observed submit "
+        "p99, and disruptions survived into a soak JSON object "
+        "(BENCH_SOAK_* overrides); exit 1 on a red composite verdict",
+    )
     args = ap.parse_args(argv)
-    if args.cluster:
+    if args.soak:
+        run_soak()
+    elif args.cluster:
         run_cluster()
     elif args.chaos_overhead:
         run_chaos_overhead()

@@ -87,6 +87,14 @@ EXTRA_KEYS = [
     # not fall, tail latency must not grow
     ("cluster.tx_per_s", True),
     ("cluster.submit_p99_s", False),
+    # production-day soak artifacts (bench.py --soak): acked client
+    # tx/s under the composed fault schedule, client-observed p99
+    # submit→ack latency, and the number of disruption windows the
+    # cluster advanced past — throughput and survival must not fall,
+    # tail latency must not grow
+    ("soak.tx_per_s", True),
+    ("soak.submit_p99_s", False),
+    ("soak.disruptions_survived", True),
     # dispatch-profiler artifacts (bench.py --stream): the non-device
     # per-chunk cost (wall minus stage time) the streaming engine pays —
     # LOWER is better; a driver change that adds host work or transfer
@@ -182,6 +190,28 @@ def scale_audit_gate(new: Dict) -> Optional[str]:
     )
 
 
+def soak_gate(new: Dict) -> Optional[str]:
+    """Refuse a candidate whose soak run went red.  bench.py --soak
+    stamps ``soak.verdict_ok`` — the composite verdict (oracle-replay
+    bit-parity, liveness past every disruption window, finality-tail
+    budget, zero shed-accounting leaks) over the composed chaos
+    scenario.  A red soak means the numbers were measured on a cluster
+    that lost safety, liveness, or transactions; they are not
+    comparable regardless of how good they look.  Artifacts without the
+    stamp (non-soak benches) pass untouched."""
+    soak = new.get("soak")
+    if not isinstance(soak, dict) or "verdict_ok" not in soak:
+        return None
+    if soak.get("verdict_ok"):
+        return None
+    return (
+        "candidate's soak verdict is red (soak.verdict_ok false): the "
+        "cluster lost safety, liveness, finality budget, or shed "
+        "accounting under the composed schedule; replay the minimized "
+        "schedule doc from scripts/soak_run.py, fix, and re-bench"
+    )
+
+
 def trace_overhead_gate(new: Dict) -> Optional[str]:
     """Refuse a candidate whose own profiled sample shows tracing
     perturbing the streaming run by more than
@@ -243,7 +273,7 @@ def main(argv=None) -> int:
     with open(args.new) as f:
         new = unwrap(json.load(f))
     for gate in (lint_gate(new), mc_gate(new), scale_audit_gate(new),
-                 trace_overhead_gate(new)):
+                 trace_overhead_gate(new), soak_gate(new)):
         if gate is not None:
             print(f"\nFAIL: {gate}", file=sys.stderr)
             return 1

@@ -47,3 +47,41 @@ print(f"fused-kernel SW003/SW004 gate: "
       f"{len(bad)} finding{'s' if len(bad) != 1 else ''}")
 sys.exit(1 if bad else 0)
 EOF
+#   4. soak-plane modules (the TCP fault proxy, the heavy-tailed traffic
+#      generator, and the soak orchestrator) must be SW002/SW003-clean
+#      with JUSTIFIED suppressions only: these drive real sockets and
+#      wall-clock schedules but their fault draws, schedules, and
+#      verdicts must replay bit-identically from the seed — an
+#      unordered-set walk or an unjustified wall read there breaks
+#      ddmin shrink reproducibility.
+env JAX_PLATFORMS=cpu python - <<'EOF'
+import sys
+
+from tpu_swirld.analysis.lint import lint_paths, _suppression_comments
+
+SOAK_MODULES = [
+    "tpu_swirld/net/proxy.py",
+    "tpu_swirld/net/traffic.py",
+    "tpu_swirld/soak.py",
+]
+GUARDED = {"SW002", "unordered-iter", "SW003", "wall-clock", "all"}
+
+bad = [f.render() for f in lint_paths(SOAK_MODULES, rules=["SW002", "SW003"])]
+for path in SOAK_MODULES:
+    with open(path, encoding="utf-8") as fh:
+        src = fh.read()
+    for lineno, kind, ids, note in _suppression_comments(src):
+        if not (ids & GUARDED):
+            continue
+        if kind == "file" or not note:
+            bad.append(
+                f"{path}:{lineno}: unjustified suppression of "
+                f"{','.join(sorted(ids & GUARDED))} in a soak-plane "
+                f"module (needs a line disable with a '-- why' note)"
+            )
+for line in bad:
+    print(line)
+print(f"soak-plane SW002/SW003 gate: "
+      f"{len(bad)} finding{'s' if len(bad) != 1 else ''}")
+sys.exit(1 if bad else 0)
+EOF

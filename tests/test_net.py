@@ -7,6 +7,7 @@ Everything here runs in-process or over loopback sockets owned by the
 test; the real-process cluster lives in tests/test_cluster.py.
 """
 
+import collections
 import os
 import random
 import socket
@@ -28,8 +29,10 @@ from tpu_swirld.obs.flightrec import FlightRecorder, load_dump
 from tpu_swirld.obs.tracer import pack_context
 from tpu_swirld.oracle.event import Event, encode_event
 from tpu_swirld.oracle.node import Node
+from tpu_swirld.net.proxy import FaultyProxy, ProxyFleet
 from tpu_swirld.transport import (
-    CHANNEL_SYNC, DeliveryTimeout, PeerUnreachable, Transport,
+    CHANNEL_SYNC, DeliveryTimeout, FaultPlan, LinkFaults, Partition,
+    PeerUnreachable, Transport,
 )
 
 # ------------------------------------------------------------- framing
@@ -804,4 +807,294 @@ def test_node_server_worker_threads_keep_no_state():
         assert done.wait(5)
         assert seen == [(frame.KIND_PING, b"me", b"probe", b"")]
     finally:
+        server.close()
+
+# ------------------------------------------------- socket fault proxy
+
+
+def _echo_node(port):
+    def dispatch(kind, src, payload, trace=b""):
+        return frame.STATUS_OK, b"pong:" + payload
+
+    return NodeServer("127.0.0.1", port, dispatch, frame.MAX_FRAME_BYTES)
+
+
+def _proxy_call(addr, payload, timeout=5.0):
+    with socket.create_connection(tuple(addr), timeout=timeout) as s:
+        s.settimeout(timeout)
+        frame.send_request(s, frame.KIND_SYNC, b"tester", payload)
+        return frame.recv_reply(s)
+
+
+def test_faulty_proxy_clean_relay():
+    """A fault-free plan relays frames bit-intact in both directions."""
+    (up_port,) = allocate_ports(1)
+    server = _echo_node(up_port)
+    stats = collections.Counter()
+    proxy = FaultyProxy(
+        0, 1, ("127.0.0.1", up_port), FaultPlan(seed=5),
+        clock=lambda: 0.0, count=lambda k: stats.update([k]),
+    )
+    try:
+        for i in range(3):
+            status, reply = _proxy_call(proxy.addr, b"hello-%d" % i)
+            assert (status, reply) == (frame.STATUS_OK, b"pong:hello-%d" % i)
+        assert stats["relayed"] == 3
+        assert stats["drops"] == 0 and stats["partition_blocked"] == 0
+    finally:
+        proxy.close()
+        server.close()
+
+
+def test_faulty_proxy_partition_blocks_then_heals():
+    """Inside a scheduled partition window the proxy eats the frame and
+    tears the connection; once the injected clock passes the window the
+    same link relays again — no proxy restart, no reconfiguration."""
+    (up_port,) = allocate_ports(1)
+    server = _echo_node(up_port)
+    stats = collections.Counter()
+    now = [5.0]
+    plan = FaultPlan(
+        seed=5, partitions=[Partition(start=0.0, end=10.0, group=(0,))],
+    )
+    proxy = FaultyProxy(
+        0, 1, ("127.0.0.1", up_port), plan,
+        clock=lambda: now[0], count=lambda k: stats.update([k]),
+    )
+    try:
+        with socket.create_connection(tuple(proxy.addr), timeout=5) as s:
+            s.settimeout(5.0)
+            frame.send_request(s, frame.KIND_SYNC, b"t", b"blocked")
+            with pytest.raises((ConnectionError, FrameError)):
+                frame.recv_reply(s)
+        assert stats["partition_blocked"] == 1
+        assert stats["relayed"] == 0
+        now[0] = 10.0   # heal: start <= t < end no longer holds
+        status, reply = _proxy_call(proxy.addr, b"after")
+        assert (status, reply) == (frame.STATUS_OK, b"pong:after")
+        assert stats["relayed"] == 1
+    finally:
+        proxy.close()
+        server.close()
+
+
+def test_faulty_proxy_drop_and_reset_semantics():
+    """drop=1.0 loses the request BEFORE the upstream sees it; reset=1.0
+    tears the client connection AFTER the upstream processed the request
+    (the redial-after-success hazard the transport must absorb)."""
+    (up_port,) = allocate_ports(1)
+    seen = []
+
+    def dispatch(kind, src, payload, trace=b""):
+        seen.append(payload)
+        return frame.STATUS_OK, b"ok"
+
+    server = NodeServer("127.0.0.1", up_port, dispatch, frame.MAX_FRAME_BYTES)
+    stats = collections.Counter()
+
+    def mk(lf):
+        return FaultyProxy(
+            0, 1, ("127.0.0.1", up_port), FaultPlan(seed=7, default=lf),
+            clock=lambda: 0.0, count=lambda k: stats.update([k]),
+        )
+
+    dropper = mk(LinkFaults(drop=1.0))
+    try:
+        with socket.create_connection(tuple(dropper.addr), timeout=5) as s:
+            s.settimeout(5.0)
+            frame.send_request(s, frame.KIND_SYNC, b"t", b"lost")
+            with pytest.raises((ConnectionError, FrameError)):
+                frame.recv_reply(s)
+        assert stats["drops"] >= 1 and seen == []
+    finally:
+        dropper.close()
+
+    resetter = mk(LinkFaults(reset=1.0))
+    try:
+        with socket.create_connection(tuple(resetter.addr), timeout=5) as s:
+            s.settimeout(5.0)
+            frame.send_request(s, frame.KIND_SYNC, b"t", b"processed")
+            with pytest.raises((ConnectionError, FrameError)):
+                frame.recv_reply(s)
+        assert stats["resets"] >= 1
+        assert seen == [b"processed"]   # the destination DID apply it
+    finally:
+        resetter.close()
+        server.close()
+
+
+def test_proxy_fleet_routes_every_directed_link():
+    """One proxy per directed pair, each with its own port; frames sent
+    to addr_for(i, j) land on upstream j; shared stats aggregate."""
+    ports = allocate_ports(2)
+    servers = [_echo_node(p) for p in ports]
+    fleet = ProxyFleet(FaultPlan(seed=3), 2, ports)
+    try:
+        addrs = {
+            (i, j): fleet.addr_for(i, j)
+            for i in range(2) for j in range(2) if i != j
+        }
+        assert len(set(addrs.values())) == 2   # distinct listeners
+        assert set(addrs.values()).isdisjoint(
+            {("127.0.0.1", p) for p in ports}
+        )
+        for (i, j), addr in sorted(addrs.items()):
+            status, reply = _proxy_call(addr, b"link-%d-%d" % (i, j))
+            assert (status, reply) == (
+                frame.STATUS_OK, b"pong:link-%d-%d" % (i, j),
+            )
+        assert fleet.stats["relayed"] == 2
+    finally:
+        fleet.close()
+        for s in servers:
+            s.close()
+
+
+def test_socket_transport_reprobe_bridges_restart_gap():
+    """satellite: a peer mid-restart kills the cached connection AND has
+    no listener bound yet.  The transparent redial's cold connect fails;
+    the bounded re-probe (redial_probe_s) must bridge the gap so the
+    call succeeds with one redial + one probe instead of surfacing a
+    spurious PeerUnreachable."""
+    (port,) = allocate_ports(1)
+    ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    ls.bind(("127.0.0.1", port))
+    ls.listen(1)
+
+    def one_shot():
+        conn, _addr = ls.accept()
+        _kind, _src, payload, _trace = frame.recv_request(conn)
+        frame.send_reply(conn, frame.STATUS_OK, b"pong:" + payload)
+        conn.close()
+        ls.close()   # the dying incarnation's listener goes away too
+
+    threading.Thread(target=one_shot, daemon=True).start()
+
+    pk_self, _ = crypto.keypair(b"probe-self")
+    pk_peer, _ = crypto.keypair(b"probe-peer")
+    settings = resolve_net_settings()
+    settings["redial_probe_s"] = 1.0
+    st = SocketTransport(settings=settings, src=pk_self)
+    st.register(pk_peer, "127.0.0.1", port)
+
+    reborn = {}
+
+    def rebind_later():
+        frame.sleep(0.35)   # the restart gap: no listener during it
+
+        def dispatch(kind, src, payload, trace=b""):
+            return frame.STATUS_OK, b"pong2:" + payload
+
+        reborn["server"] = NodeServer(
+            "127.0.0.1", port, dispatch, frame.MAX_FRAME_BYTES,
+        )
+
+    try:
+        assert st.call(pk_self, pk_peer, CHANNEL_SYNC, b"a") == b"pong:a"
+        t = threading.Thread(target=rebind_later, daemon=True)
+        t.start()
+        # cached conn is dead, listener absent: redial fails its cold
+        # connect, the probe waits out the gap, the retry lands
+        assert st.call(pk_self, pk_peer, CHANNEL_SYNC, b"b") == b"pong2:b"
+        t.join(5)
+        assert st.stats["redials"] >= 1
+        assert st.stats["redial_probes"] == 1
+    finally:
+        st.close()
+        if "server" in reborn:
+            reborn["server"].close()
+
+
+def test_wal_torn_tail_recovery_under_active_partition():
+    """satellite: kill -9 tears the WAL tail while the survivor's only
+    link is partitioned.  Recovery of the durable prefix is purely local
+    (needs no network); gossip through the healed link then backfills
+    the missing other-parents so every recovered event rejoins the DAG."""
+    n, seed = 2, 23
+    config = SwirldConfig(n_members=n, seed=seed)
+    keys = [crypto.keypair(b"walpart-%d" % i) for i in range(n)]
+    members = [pk for pk, _ in keys]
+
+    # ---- phase A: a genuine own-event chain, appended like node_proc
+    clock = [0]
+    network, network_want = {}, {}
+    transport = Transport(network, network_want)
+    nodes = []
+    for pk, sk in keys:
+        node = Node(
+            sk=sk, pk=pk, network=network, members=members, config=config,
+            clock=lambda: clock[0], network_want=network_want,
+            transport=transport,
+        )
+        network[pk] = node.ask_sync
+        network_want[pk] = node.ask_events
+        nodes.append(node)
+    import tempfile
+    wal_path = os.path.join(
+        tempfile.mkdtemp(prefix="swirld-walpart-"), "n0.wal",
+    )
+    wal = OwnEventWal(wal_path, pk=members[0])
+    wal.append(nodes[0].hg[nodes[0].head])   # durable genesis
+    for t in range(4):
+        clock[0] = t + 1
+        new = nodes[0].sync(members[1], b"tx:%d" % t)
+        if new:
+            nodes[0].consensus_pass(new)
+        wal.append(nodes[0].hg[nodes[0].head])
+    n_appended = len(wal.events)
+    wal.close()   # no mark_clean: this incarnation "dies"
+    with open(wal_path, "r+b") as f:
+        f.truncate(os.path.getsize(wal_path) - 3)   # torn mid-record
+
+    # ---- phase B: restart behind a partitioned proxy link
+    (peer_port,) = allocate_ports(1)
+    server = _serve_node(nodes[1], peer_port)
+    now = [0.0]
+    plan = FaultPlan(
+        seed=seed, partitions=[Partition(start=0.0, end=100.0, group=(0,))],
+    )
+    pstats = collections.Counter()
+    proxy = FaultyProxy(
+        0, 1, ("127.0.0.1", peer_port), plan,
+        clock=lambda: now[0], count=lambda k: pstats.update([k]),
+    )
+    st = SocketTransport(settings=resolve_net_settings(), src=members[0])
+    st.register(members[1], proxy.addr[0], proxy.addr[1])
+    try:
+        # torn-tail recovery is local: durable prefix, counted tear —
+        # with the only peer link dead
+        wal2 = OwnEventWal(wal_path, pk=members[0])
+        assert wal2.unclean
+        assert wal2.torn_tail_recovered == 1
+        assert len(wal2.events) == n_appended - 1
+        clock2 = [100]
+        node0b = Node(
+            sk=keys[0][1], pk=members[0], network={}, members=members,
+            config=config, clock=lambda: clock2[0], transport=st,
+        )
+        wal_ids = []
+        node0b._ingest(wal2.events, wal_ids)   # node_proc's boot replay
+        if wal_ids:
+            node0b.consensus_pass(wal_ids)
+        # the link is down: pull degrades to an empty delta (it never
+        # raises on peer behavior) — recovery above already held
+        assert node0b.sync(members[1], b"during-partition") == []
+        assert pstats["partition_blocked"] >= 1
+        assert st.stats["conn_errors"] >= 1
+        # the WAL keeps accepting appends during the partition
+        wal2.append(node0b.hg[node0b.head])
+        # heal: the same link carries gossip again; node1's events
+        # backfill the recovered chain's other-parents.  The clock jump
+        # also clears any breaker cooldown the dead link accrued.
+        now[0] = 100.0
+        clock2[0] = 1000
+        new = node0b.sync(members[1], b"post-heal")
+        assert new
+        missing = [e.id for e in wal2.events if e.id not in node0b.hg]
+        assert missing == []   # every recovered event rejoined the DAG
+        wal2.close()
+    finally:
+        st.close()
+        proxy.close()
         server.close()

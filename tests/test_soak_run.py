@@ -1,0 +1,223 @@
+"""Production-day soak: the composed chaos scenario and its tooling.
+
+Tier-1 runs the real smoke composition — a 4-process cluster gossiping
+through per-link TCP fault proxies under heavy-tailed client traffic
+while the schedule interleaves a byzantine equivocation storm, a
+SIGKILL crash (+ WAL recovery), and a partition/heal window — plus the
+fast units around the schedule documents, the ddmin shrinker, and the
+shed-accounting mutation seam.  The full mutation → red verdict →
+shrink → replay loop rides ``-m slow``.
+
+(tests/test_soak.py is the older in-process chaos soak; this file
+covers the socket-level composition from ``tpu_swirld.soak``.)
+"""
+
+import dataclasses
+import json
+import os
+
+import pytest
+
+from tpu_swirld import soak
+from tpu_swirld.net.traffic import OUTCOMES, TrafficPlan, classify_reply
+from tpu_swirld.soak import (
+    AttackWindow, CrashWindow, PartitionWindow,
+    load_doc, make_doc, replay_doc, save_doc,
+    smoke_schedule, spec_from_dict, spec_to_dict,
+    window_from_dict, window_to_dict,
+)
+
+pytestmark = pytest.mark.soak
+
+_FAST_NET = {"gossip_interval_s": 0.005, "checkpoint_every_s": 0.5}
+
+
+# ------------------------------------------------------------ units
+
+
+def test_window_dict_roundtrip_all_kinds():
+    windows = [
+        CrashWindow(index=1, at_s=2.0, restart_at_s=3.5),
+        PartitionWindow(start_s=1.0, end_s=4.0, group=(0, 2)),
+        AttackWindow(start_s=0.5, end_s=6.0, index=3, n_branches=3,
+                     step_every_s=0.5),
+    ]
+    for w in windows:
+        d = window_to_dict(w)
+        assert window_from_dict(json.loads(json.dumps(d))) == w
+    with pytest.raises(KeyError):
+        window_from_dict({"kind": "meteor-strike"})
+
+
+def test_schedule_doc_roundtrip(tmp_path):
+    spec = soak.default_spec(str(tmp_path), n_nodes=4, seed=9)
+    schedule = list(smoke_schedule(spec))
+    assert len(schedule) == 3   # attack + crash + partition
+    doc = make_doc(spec, schedule, {"accounting_leaked": 7})
+    path = save_doc(doc, str(tmp_path / "repro.json"))
+    back = load_doc(path)
+    assert back["kind"] == soak.DOC_KIND
+    assert back["violation"] == {"accounting_leaked": 7}
+    spec2 = spec_from_dict(back["spec"], workdir=str(tmp_path / "w2"))
+    assert spec2.schedule == tuple(schedule)
+    assert spec2.seed == spec.seed and spec2.n_nodes == spec.n_nodes
+    # a foreign JSON file is refused, not misinterpreted
+    alien = tmp_path / "alien.json"
+    alien.write_text('{"kind": "bench"}')
+    with pytest.raises(ValueError, match="soak-schedule"):
+        load_doc(str(alien))
+    # spec round-trip is lossless including the schedule
+    assert spec_from_dict(
+        json.loads(json.dumps(spec_to_dict(spec2))),
+    ) == spec2
+
+
+def test_classify_reply_covers_the_txpool_grammar():
+    assert classify_reply(b"ACK:deadbeef") == "acked"
+    assert classify_reply(b"DUP:deadbeef") == "duplicate"
+    assert classify_reply(b"SHED:window") == "shed_window"
+    assert classify_reply(b"SHED:pool") == "shed_pool"
+    assert classify_reply(b"SHED:oversize") == "shed_oversize"
+    assert classify_reply(b"garbage") == "unclassified"
+    for bucket in ("acked", "duplicate", "shed_window", "shed_pool",
+                   "shed_oversize"):
+        assert bucket in OUTCOMES
+
+
+def test_traffic_plan_rejects_undefined_pareto_mean():
+    with pytest.raises(ValueError, match="pareto_alpha"):
+        TrafficPlan(pareto_alpha=1.0)
+    TrafficPlan(pareto_alpha=1.5)   # finite-mean tail is accepted
+
+
+def test_shed_leak_mutation_drops_exactly_the_window_bucket():
+    """The seeded defect: SHED:window replies vanish from the client
+    ledger (classify -> None) while every other outcome still counts —
+    the uniform shed-accounting balance check must go red on it."""
+    net = {}
+    leaky, net = soak.MUTATIONS["shed-leak"](net)
+    assert leaky(b"SHED:window") is None
+    assert leaky(b"SHED:pool") == "shed_pool"
+    assert leaky(b"ACK:00ff") == "acked"
+    # the mutation also pressures the admission window so the leaked
+    # bucket actually fills during a short run
+    assert "max_undecided" in net
+
+
+def test_shrink_ddmin_minimizes_schedule(tmp_path, monkeypatch):
+    """ddmin over windows with stubbed probes: failure iff a partition
+    window is present -> the doc reduces to exactly that window, and
+    each probe ran in its own probe-<n> workdir."""
+    spec = soak.default_spec(str(tmp_path), n_nodes=4, seed=5)
+    schedule = smoke_schedule(spec)
+    spec = dataclasses.replace(spec, schedule=schedule)
+    probe_dirs = []
+
+    def fake_run_soak(probe):
+        probe_dirs.append(os.path.basename(probe.workdir))
+        bad = any(isinstance(w, PartitionWindow) for w in probe.schedule)
+        return {
+            "ok": not bad,
+            "safety": {"oracle_agree": True},
+            "liveness": {"advanced_after_heal": not bad},
+            "disruptions_survived": 0 if bad else len(probe.schedule),
+            "finality": {"ok": True},
+            "accounting": {"leaked": 42 if bad else 0,
+                           "balance_ok": not bad},
+        }
+
+    monkeypatch.setattr(soak, "run_soak", fake_run_soak)
+    doc = soak.shrink(spec)
+    assert [w["kind"] for w in doc["schedule"]] == ["partition"]
+    assert doc["probes"] == len(probe_dirs) >= 2
+    assert all(d.startswith("probe-") for d in probe_dirs)
+    assert doc["violation"]["accounting_leaked"] == 42
+    assert doc["violation"]["liveness_advanced"] is False
+    # the reduced doc replays through the same entry point
+    verdict = replay_doc(doc, str(tmp_path / "replay"))
+    assert verdict["ok"] is False
+
+
+def test_shrink_refuses_a_green_schedule(tmp_path, monkeypatch):
+    spec = soak.default_spec(str(tmp_path), n_nodes=4, seed=5)
+    spec = dataclasses.replace(spec, schedule=smoke_schedule(spec))
+    monkeypatch.setattr(
+        soak, "run_soak",
+        lambda probe: {"ok": True, "safety": {}, "liveness": {},
+                       "disruptions_survived": 3, "finality": {},
+                       "accounting": {}},
+    )
+    with pytest.raises(ValueError):
+        soak.shrink(spec)
+
+
+# ------------------------------------------- the smoke composition
+
+
+def test_soak_smoke_composition_survives_every_disruption(tmp_path):
+    """The tier-1 production-day smoke: every disruption kind at least
+    once (equivocation storm through the proxy seam, kill -9 + WAL
+    recovery, partition/heal), under heavy-tailed traffic — composite
+    verdict green, liveness past every window, books balanced."""
+    spec = soak.default_spec(
+        str(tmp_path), n_nodes=4, seed=3, horizon_s=6.5,
+        tx_rate=120.0, n_clients=3, net=dict(_FAST_NET),
+    )
+    spec = dataclasses.replace(spec, schedule=smoke_schedule(spec))
+    kinds = {type(w) for w in spec.schedule}
+    assert kinds == {AttackWindow, CrashWindow, PartitionWindow}
+
+    v = soak.run_soak(spec)
+    assert v["ok"], json.dumps(
+        {k: v[k] for k in ("safety", "liveness", "finality",
+                           "accounting", "disruptions_survived")},
+        default=str,
+    )
+    # safety: every honest order is a bit-exact oracle prefix
+    assert v["safety"]["oracle_agree"] and v["safety"]["prefix_agree"]
+    # liveness: decided past EVERY window's end, not just the last heal
+    assert v["disruptions_survived"] == v["disruptions_total"] == 3
+    # the wire actually went through the interposers, and the partition
+    # window actually bit
+    assert v["proxy"]["relayed"] > 0
+    assert v["proxy"]["partition_blocked"] > 0
+    # the storm ran and the honest side convicted it
+    assert v["adversary"]["attack_steps"] > 0
+    assert v["adversary"]["equivocations_detected"] > 0
+    # the SIGKILL victim came back (restarted, unclean start observed)
+    victims = [row for row in v["nodes"] if row["restarts"] >= 1]
+    assert victims and all(row["unclean_start"] for row in victims)
+    # shed accounting balances to the submission count exactly
+    assert v["accounting"]["balance_ok"]
+    assert v["accounting"]["leaked"] == 0
+    assert v["accounting"]["submitted"] > 0
+
+
+# ------------------------------------------------- the full loop
+
+
+@pytest.mark.slow
+def test_soak_mutation_goes_red_shrinks_and_replays(tmp_path):
+    """The teeth: the seeded shed-accounting leak must flip the verdict
+    red on accounting alone, ddmin must reduce the schedule to a
+    replayable doc, and the doc must reproduce the red verdict."""
+    spec = soak.default_spec(
+        str(tmp_path), n_nodes=4, seed=3, horizon_s=6.5,
+        tx_rate=120.0, n_clients=3, mutate="shed-leak",
+        net=dict(_FAST_NET),
+    )
+    spec = dataclasses.replace(spec, schedule=smoke_schedule(spec))
+    v = soak.run_soak(spec)
+    assert not v["ok"]
+    assert v["accounting"]["leaked"] > 0
+    assert not v["accounting"]["balance_ok"]
+    # red verdicts dump the flight recorder for post-mortem
+    assert v["flightrec_dump"]
+
+    doc = soak.shrink(spec)
+    assert doc["schedule"]   # ddmin never returns an empty failure
+    assert doc["probes"] >= 1
+    path = save_doc(doc, str(tmp_path / "minimized.schedule.json"))
+    replay = replay_doc(load_doc(path), str(tmp_path / "replay"))
+    assert not replay["ok"]
+    assert replay["accounting"]["leaked"] > 0

@@ -178,6 +178,7 @@ class UnorderedIterRule(Rule):
         "oracle/", "store/streaming.py", "tpu/pipeline.py", "chaos.py",
         "adversary.py", "obs/finality.py", "obs/flightrec.py",
         "obs/cluster_trace.py", "obs/profile.py",
+        "net/proxy.py", "net/traffic.py", "soak.py",
     )
 
     _FIX = (
@@ -313,7 +314,7 @@ class WallClockRule(Rule):
     scope = (
         "transport.py", "oracle/node.py", "obs/finality.py",
         "obs/flightrec.py", "net/", "obs/cluster_trace.py",
-        "obs/profile.py",
+        "obs/profile.py", "soak.py",
     )
     # net/ is the socket deployment edge: real deadlines, pacing, and tx
     # latency genuinely need wall time — but each read must say *why* at
@@ -323,7 +324,10 @@ class WallClockRule(Rule):
     # net layer stays enumerable and every entry self-documents.
     # obs/profile.py: the dispatch profiler's single timing callsite is
     # its one legitimate wall read — justified there, nowhere else.
-    note_scope = ("net/", "obs/profile.py")
+    # soak.py drives real processes on a wall-clock schedule; same rule:
+    # every wall read routes through frame.now()/frame.sleep() or a
+    # justified line suppression.
+    note_scope = ("net/", "obs/profile.py", "soak.py")
 
     _FIX = (
         "in the logical-time transport/retry layer; fix: advance the "

@@ -83,6 +83,7 @@ _NET_ENV = {
     "net_gossip_interval_s": ("SWIRLD_NET_GOSSIP_INTERVAL", 0.01, float),
     "net_checkpoint_every_s": ("SWIRLD_NET_CHECKPOINT_EVERY", 1.0, float),
     "net_retry_tick_s": ("SWIRLD_NET_RETRY_TICK", 0.02, float),
+    "net_redial_probe_s": ("SWIRLD_NET_REDIAL_PROBE", 0.05, float),
 }
 
 
@@ -91,10 +92,13 @@ def resolve_net_settings(config: Optional["SwirldConfig"] = None) -> Dict:
     ``SWIRLD_NET_*`` env var > built-in default.  Returns
     ``{"connect_timeout_s", "call_timeout_s", "max_frame_bytes",
     "tx_batch_bytes", "tx_max_bytes", "tx_pool_txs", "max_undecided",
-    "gossip_interval_s", "checkpoint_every_s", "retry_tick_s"}``
-    (plain values, never ``None``).  ``retry_tick_s`` converts the
-    logical backoff ticks :class:`~tpu_swirld.transport.RetryPolicy`
-    computes into real sleep seconds for socket deployments."""
+    "gossip_interval_s", "checkpoint_every_s", "retry_tick_s",
+    "redial_probe_s"}`` (plain values, never ``None``).
+    ``retry_tick_s`` converts the logical backoff ticks
+    :class:`~tpu_swirld.transport.RetryPolicy` computes into real sleep
+    seconds for socket deployments; ``redial_probe_s`` bounds the single
+    re-probe wait after a failed transparent redial (a peer mid-restart
+    whose new listener is not yet bound)."""
     out = {}
     for field, (env, default, parse) in _NET_ENV.items():
         v = getattr(config, field, None) if config is not None else None
@@ -102,6 +106,41 @@ def resolve_net_settings(config: Optional["SwirldConfig"] = None) -> Dict:
             raw = os.environ.get(env)
             v = parse(raw) if raw is not None else default
         out[field[len("net_"):]] = v
+    return out
+
+
+#: built-in production-day-soak defaults (field -> (env var, default,
+#: parser)).  Same precedence as every other knob family: explicit
+#: SwirldConfig field > SWIRLD_SOAK_* env var > built-in default.  The
+#: soak orchestrator (:mod:`tpu_swirld.soak`) reads these for its spec
+#: defaults; wall-second units, like the net knobs — the soak is a
+#: deployment-edge harness, never part of the consensus core.
+_SOAK_ENV = {
+    "soak_horizon_s": ("SWIRLD_SOAK_HORIZON", 8.0, float),
+    "soak_nodes": ("SWIRLD_SOAK_NODES", 4, int),
+    "soak_tx_rate": ("SWIRLD_SOAK_TX_RATE", 150.0, float),
+    "soak_clients": ("SWIRLD_SOAK_CLIENTS", 3, int),
+    "soak_tx_bytes": ("SWIRLD_SOAK_TX_BYTES", 64, int),
+    "soak_pareto_alpha": ("SWIRLD_SOAK_PARETO_ALPHA", 1.5, float),
+    "soak_finality_budget_s": ("SWIRLD_SOAK_FINALITY_BUDGET", 6.0, float),
+}
+
+
+def resolve_soak_settings(config: Optional["SwirldConfig"] = None) -> Dict:
+    """Concrete production-day-soak settings: explicit config field >
+    ``SWIRLD_SOAK_*`` env var > built-in default.  Returns
+    ``{"horizon_s", "nodes", "tx_rate", "clients", "tx_bytes",
+    "pareto_alpha", "finality_budget_s"}`` (plain values, never
+    ``None``).  ``finality_budget_s`` is the composite verdict's p99
+    submission→decided latency ceiling; ``pareto_alpha`` shapes the
+    traffic generator's heavy-tailed inter-arrival draw."""
+    out = {}
+    for field, (env, default, parse) in _SOAK_ENV.items():
+        v = getattr(config, field, None) if config is not None else None
+        if v is None:
+            raw = os.environ.get(env)
+            v = parse(raw) if raw is not None else default
+        out[field[len("soak_"):]] = v
     return out
 
 

@@ -60,6 +60,8 @@ from tpu_swirld.config import SwirldConfig
 from tpu_swirld.net import frame
 from tpu_swirld.net.frame import allocate_ports
 from tpu_swirld.net.node_proc import derive_paths
+from tpu_swirld.net.proxy import ProxyFleet
+from tpu_swirld.net.traffic import classify_reply
 from tpu_swirld.obs import cluster_trace
 from tpu_swirld.obs.finality import merged_dist
 from tpu_swirld.obs.registry import merge_node_samples, rollup_node_samples
@@ -77,6 +79,14 @@ class ClusterSpec:
     recovery).  ``net`` overrides land in every node's
     :func:`~tpu_swirld.config.resolve_net_settings` dict (stripped key
     names, e.g. ``{"gossip_interval_s": 0.005}``).
+
+    ``proxy_plan`` (a :class:`~tpu_swirld.transport.FaultPlan`) routes
+    every node-to-node gossip link through a per-link
+    :class:`~tpu_swirld.net.proxy.FaultyProxy` interposer; the
+    supervisor's own control plane stays direct.  ``external_indices``
+    reserves member slots the supervisor must NOT launch, probe, or
+    count toward reports — the soak harness runs byzantine adversaries
+    in those slots itself.
     """
 
     workdir: str
@@ -94,6 +104,15 @@ class ClusterSpec:
     ready_timeout_s: float = 30.0
     stop_timeout_s: float = 60.0
     net: Dict = dataclasses.field(default_factory=dict)
+    proxy_plan: Optional[object] = None
+    external_indices: Tuple[int, ...] = ()
+
+    def managed_indices(self) -> List[int]:
+        """Member slots this supervisor launches and holds to account."""
+        return [
+            i for i in range(self.n_nodes)
+            if i not in self.external_indices
+        ]
 
 
 class ClusterClient:
@@ -160,6 +179,51 @@ def observer_keypair(seed: int) -> Tuple[bytes, bytes]:
     return crypto.keypair(b"cluster-observer-%d" % seed)
 
 
+def collect_node_state(
+    workdir: str,
+    indices: List[int],
+    exit_codes: Dict[int, Optional[int]],
+    restarts: Dict[int, int],
+) -> Tuple[Dict[int, Dict], Dict[bytes, "Event"], List[Dict]]:
+    """Gather what each node left on disk: ``(reports, union, rows)``.
+
+    ``reports`` maps index -> the node's final report JSON, ``union`` is
+    the union DAG over every node's ``events.bin`` (oracle-replay
+    input), ``rows`` are the per-node verdict rows.  Shared by the
+    cluster verdict and the soak orchestrator so both judge runs from
+    the identical evidence."""
+    reports: Dict[int, Dict] = {}
+    union: Dict[bytes, Event] = {}
+    rows: List[Dict] = []
+    for i in indices:
+        paths = derive_paths(workdir, i)
+        row: Dict = {
+            "index": i,
+            "exit_code": exit_codes.get(i),
+            "restarts": restarts.get(i, 0),
+            "flightrec_dump": None,
+        }
+        if os.path.exists(paths["report"]):
+            with open(paths["report"]) as f:
+                rep = json.load(f)
+            reports[i] = rep
+            row.update({
+                "decided": len(rep["decided"]),
+                "decided_tx": rep["decided_tx"],
+                "events": rep["events"],
+                "unclean_start": rep["unclean_start"],
+                "flightrec_dump": rep["flightrec_dump"],
+                "counters": rep["counters"],
+            })
+        else:
+            row["missing_report"] = True
+        if os.path.exists(paths["events"]):
+            for ev in read_event_log(paths["events"]):
+                union.setdefault(ev.id, ev)
+        rows.append(row)
+    return reports, union, rows
+
+
 def read_event_log(path: str) -> List[Event]:
     """Decode a node's ``events.bin`` dump (``encode_event`` blobs,
     concatenated in topo order); stops at the first malformed byte."""
@@ -185,6 +249,13 @@ class ClusterSupervisor:
         if spec.flightrec_dir:
             os.makedirs(spec.flightrec_dir, exist_ok=True)
         self.ports = allocate_ports(spec.n_nodes, spec.host)
+        # socket-level fault injection: one TCP interposer per directed
+        # gossip link, sharing the in-process FaultPlan vocabulary
+        self.fleet: Optional[ProxyFleet] = None
+        if spec.proxy_plan is not None:
+            self.fleet = ProxyFleet(
+                spec.proxy_plan, spec.n_nodes, self.ports, host=spec.host,
+            )
         self.procs: Dict[int, subprocess.Popen] = {}
         self.exit_codes: Dict[int, Optional[int]] = {}
         self.restarts: Dict[int, int] = {}
@@ -204,20 +275,26 @@ class ClusterSupervisor:
     def _write_node_spec(self, i: int) -> str:
         spec = self.spec
         path = self._spec_path(i)
+        doc = {
+            "index": i,
+            "n_nodes": spec.n_nodes,
+            "seed": spec.seed,
+            "host": spec.host,
+            "ports": self.ports,
+            "workdir": spec.workdir,
+            "flightrec_dir": spec.flightrec_dir,
+            # orphan safety net: a node outliving its supervisor
+            # (supervisor crash, wedged stop) self-terminates
+            "duration_s": spec.duration_s * 3 + 60.0,
+            "net": spec.net,
+        }
+        if self.fleet is not None:
+            doc["peer_addrs"] = {
+                str(j): list(self.fleet.addr_for(i, j))
+                for j in range(spec.n_nodes) if j != i
+            }
         with open(path, "w") as f:
-            json.dump({
-                "index": i,
-                "n_nodes": spec.n_nodes,
-                "seed": spec.seed,
-                "host": spec.host,
-                "ports": self.ports,
-                "workdir": spec.workdir,
-                "flightrec_dir": spec.flightrec_dir,
-                # orphan safety net: a node outliving its supervisor
-                # (supervisor crash, wedged stop) self-terminates
-                "duration_s": spec.duration_s * 3 + 60.0,
-                "net": spec.net,
-            }, f)
+            json.dump(doc, f)
         return path
 
     def launch(self, i: int) -> None:
@@ -365,6 +442,8 @@ class ClusterSupervisor:
                     proc.wait()
             self.exit_codes[i] = proc.returncode
         self.client.close()
+        if self.fleet is not None:
+            self.fleet.close()
         for log in self._logs:
             try:
                 log.close()
@@ -377,19 +456,23 @@ def run_cluster(spec: ClusterSpec) -> Dict:
     (see module docstring); never raises on node behavior — setup
     failures (ports, spawn, readiness) do raise."""
     sup = ClusterSupervisor(spec)
-    for i in range(spec.n_nodes):
+    managed = spec.managed_indices()
+    for i in managed:
         sup._write_node_spec(i)
         sup.launch(i)
     tx = {
         "submitted": 0, "acked": 0, "shed": 0, "duplicate": 0,
-        "failed": 0,
+        "failed": 0, "shed_window": 0, "shed_pool": 0,
+        "shed_oversize": 0, "unclassified": 0,
     }
     killed = False
     restarted = False
     decided_at_heal: Optional[int] = None
     heal_wall_s: Optional[float] = None
     try:
-        sup.wait_ready(list(range(spec.n_nodes)))
+        sup.wait_ready(managed)
+        if sup.fleet is not None:
+            sup.fleet.start_clock()   # partition windows count from here
         t0 = frame.now()
         t_end = t0 + spec.duration_s
         gap = 1.0 / spec.tx_rate if spec.tx_rate > 0 else None
@@ -419,7 +502,7 @@ def run_cluster(spec: ClusterSpec) -> Dict:
                 restarted = True
                 heal_wall_s = frame.now() - t0
                 decided = []
-                for i in range(spec.n_nodes):
+                for i in managed:
                     try:
                         decided.append(sup.client.status(i)["decided"])
                     except OSError:
@@ -427,7 +510,7 @@ def run_cluster(spec: ClusterSpec) -> Dict:
                 decided_at_heal = min(decided) if decided else 0
             if gap is not None and now >= next_submit:
                 next_submit += gap
-                target = k % spec.n_nodes
+                target = managed[k % len(managed)]
                 payload = (b"tx-%08d:" % k).ljust(spec.tx_bytes, b"x")
                 k += 1
                 tx["submitted"] += 1
@@ -448,15 +531,15 @@ def run_cluster(spec: ClusterSpec) -> Dict:
                         tx["failed"] += 1   # crash window: expected
                         sp.args["outcome"] = "failed"
                         continue
-                    if reply.startswith(b"ACK:"):
-                        tx["acked"] += 1
-                        sp.args["outcome"] = "acked"
-                    elif reply.startswith(b"DUP:"):
-                        tx["duplicate"] += 1
-                        sp.args["outcome"] = "duplicate"
-                    else:
+                    # uniform per-kind accounting: all three shed kinds
+                    # land in their own bucket AND the aggregate, so the
+                    # overload leg's shed rate is exact even when the
+                    # sheds are SHED:window during a partition
+                    bucket = classify_reply(reply) or "unclassified"
+                    tx[bucket] = tx.get(bucket, 0) + 1
+                    if bucket.startswith("shed_"):
                         tx["shed"] += 1
-                        sp.args["outcome"] = "shed"
+                    sp.args["outcome"] = bucket
             frame.sleep(min(0.002, gap or 0.002))
         # closing sweep with every node up: the rollup covers the fleet
         if poll_gap is not None:
@@ -493,35 +576,10 @@ def _verdict(
     and event logs left on disk."""
     members = [pk for pk, _ in member_keys(spec.n_nodes, spec.seed)]
     config = SwirldConfig(n_members=spec.n_nodes, seed=spec.seed)
-    reports: Dict[int, Dict] = {}
-    union: Dict[bytes, Event] = {}
-    nodes: List[Dict] = []
-    for i in range(spec.n_nodes):
-        paths = derive_paths(spec.workdir, i)
-        row: Dict = {
-            "index": i,
-            "exit_code": sup.exit_codes.get(i),
-            "restarts": sup.restarts.get(i, 0),
-            "flightrec_dump": None,
-        }
-        if os.path.exists(paths["report"]):
-            with open(paths["report"]) as f:
-                rep = json.load(f)
-            reports[i] = rep
-            row.update({
-                "decided": len(rep["decided"]),
-                "decided_tx": rep["decided_tx"],
-                "events": rep["events"],
-                "unclean_start": rep["unclean_start"],
-                "flightrec_dump": rep["flightrec_dump"],
-                "counters": rep["counters"],
-            })
-        else:
-            row["missing_report"] = True
-        if os.path.exists(paths["events"]):
-            for ev in read_event_log(paths["events"]):
-                union.setdefault(ev.id, ev)
-        nodes.append(row)
+    reports, union, nodes = collect_node_state(
+        spec.workdir, spec.managed_indices(),
+        sup.exit_codes, sup.restarts,
+    )
     orders = [
         [bytes.fromhex(e) for e in rep["decided"]]
         for _, rep in sorted(reports.items())
@@ -540,8 +598,9 @@ def _verdict(
     liveness = liveness_section(
         decided_final, decided_at_heal, heal_turn=heal_wall_s or 0,
     )
-    expected_reports = spec.n_nodes if (restarted or not killed) \
-        else spec.n_nodes - 1
+    n_managed = len(spec.managed_indices())
+    expected_reports = n_managed if (restarted or not killed) \
+        else n_managed - 1
     clean_exits = all(
         c == 0 for i, c in sup.exit_codes.items()
         if not (killed and not restarted and i == spec.kill_index)
@@ -565,7 +624,8 @@ def _verdict(
     shed_counters = {}
     for name in ("tx_shed_window", "tx_shed_pool", "tx_shed_oversize",
                  "tx_duplicate", "tx_accepted", "tx_submitted",
-                 "wal_torn_tail_recovered"):
+                 "wal_torn_tail_recovered",
+                 "net_redials", "net_redial_probes"):
         shed_counters[name] = sum(
             rep["counters"].get(name, 0) for rep in reports.values()
         )
@@ -586,6 +646,7 @@ def _verdict(
         },
         "tx": out_tx,
         "counters": shed_counters,
+        "proxy": dict(sup.fleet.stats) if sup.fleet is not None else {},
         "nodes": nodes,
         "reports": len(reports),
         "trace": trace_section or {},

@@ -261,9 +261,17 @@ class NodeRuntime:
         # --- transport + node (checkpoint restore when one exists) -------
         sock_transport = SocketTransport(settings=self.settings, src=self.pk)
         sock_transport.trace_provider = lambda: self._gossip_ctx
+        # optional per-peer address overrides: the soak supervisor routes
+        # node-to-node links through its FaultyProxy fleet by pointing
+        # each peer at the matching link proxy instead of the real port
+        peer_addrs = {
+            int(k): (v[0], int(v[1]))
+            for k, v in (spec.get("peer_addrs") or {}).items()
+        }
         for j, pk_j in enumerate(self.members):
             if j != self.index:
-                sock_transport.register(pk_j, self.host, self.ports[j])
+                h, p = peer_addrs.get(j, (self.host, self.ports[j]))
+                sock_transport.register(pk_j, h, p)
         self.transport = sock_transport
         yielding = _YieldingTransport(sock_transport, self.lock)
         self.restored = os.path.exists(self.paths["ckpt"])
@@ -600,6 +608,9 @@ class NodeRuntime:
         counters["node_bad_replies"] = node.bad_replies
         counters["node_bad_requests"] = node.bad_requests
         counters["node_circuit_opens"] = node.circuit_opens
+        counters["node_equivocations_detected"] = \
+            node.equivocations_detected
+        counters["node_budget_exhausted"] = node.budget_exhausted
         report = {
             "report_version": REPORT_VERSION,
             "node": self.label,

@@ -30,6 +30,14 @@ request/reply exchange is in flight per connection — the node's gossip
 loop is single-threaded, so no framing interleave is possible.  Real
 deadlines come from the ``SWIRLD_NET_*`` knobs
 (:func:`~tpu_swirld.config.resolve_net_settings`).
+
+Peer restarts are a two-step race: the dead cached connection triggers
+one transparent redial (counted in ``stats["redials"]``), and when that
+redial's *connect* also fails — the restarting peer's new listener is
+not bound yet — one bounded re-probe (``redial_probe_s``, counted in
+``stats["redial_probes"]``) runs before the call surfaces as
+:class:`PeerUnreachable`.  The cluster verdict mirrors the totals as
+``net_redials`` so a soak run can assert reconnect behavior.
 """
 
 from __future__ import annotations
@@ -160,7 +168,19 @@ class SocketTransport(Transport):
             sock = self._conns.get(dst)
             reused = sock is not None
             if sock is None:
-                sock = self._connect(dst, addr)
+                try:
+                    sock = self._connect(dst, addr)
+                except PeerUnreachable:
+                    if attempt == 0:
+                        raise   # cold connect failed: peer genuinely away
+                    # redial window: the peer that just closed our cached
+                    # connection is likely mid-restart (its old listener
+                    # is down, the new one not yet bound).  One bounded
+                    # re-probe turns that race into a deterministic
+                    # reconnect instead of a spurious PeerUnreachable.
+                    self._count("redial_probes")
+                    frame.sleep(self.settings["redial_probe_s"])
+                    sock = self._connect(dst, addr)
             try:
                 frame.send_request(
                     sock, kind, src or self.src, payload, trace=trace,
@@ -178,6 +198,7 @@ class SocketTransport(Transport):
             except (ConnectionError, OSError) as e:
                 self._drop(dst)
                 if reused and attempt == 0:
+                    self._count("redials")
                     continue   # stale cached conn: redial once
                 self._count("conn_errors")
                 raise PeerUnreachable(

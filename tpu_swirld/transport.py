@@ -144,6 +144,16 @@ class LinkFaults:
     a later call; ``reorder`` swaps the fresh reply with a previously
     stashed one; ``delay`` holds the fresh reply back entirely (the caller
     times out; the reply arrives stale later).
+
+    The last three knobs only have meaning on a real wire and are applied
+    by the socket interposer (:mod:`tpu_swirld.net.proxy`), never by the
+    in-process :class:`FaultyTransport`: ``reset`` is the probability of
+    a hard connection teardown after the server already processed the
+    request (the redial-after-success hazard), ``delay_s`` is the hold
+    applied when a ``delay`` fault fires on a stream (an in-process delay
+    is a stashed stale reply instead), and ``throttle_bps`` > 0 paces
+    relayed bytes to that budget.  All default off, so every existing
+    in-process plan is byte-identical.
     """
 
     drop: float = 0.0
@@ -151,6 +161,9 @@ class LinkFaults:
     duplicate: float = 0.0
     reorder: float = 0.0
     delay: float = 0.0
+    reset: float = 0.0
+    delay_s: float = 0.0
+    throttle_bps: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)
