@@ -926,6 +926,98 @@ def run_chaos_overhead():
     print(json.dumps(out), flush=True)
 
 
+def run_churn():
+    """--churn: dynamic-membership throughput + repack tail latency.
+
+    One canonical multi-epoch schedule (a decided LEAVE then a decided
+    JOIN — ``tpu_swirld.membership.sim.churn_schedule``) is replayed
+    through the epoch-aware incremental driver and timed end to end:
+    ``churn.evps`` is schedule events per second *including* ledger
+    bookkeeping, epoch adoption, and any restatements.  The member-axis
+    repack stage is then sampled BENCH_CHURN_REPACKS times per epoch
+    boundary (fresh packer each trial, so every sample pays the real
+    add-member + device-pad cost) and ``churn.repack_p99_s`` is the p99
+    across all samples.  ``churn.epochs`` pins that the schedule really
+    decided its membership txs — a regression that silently stops
+    deciding would otherwise *raise* evps.  bench_compare.py gates evps
+    and epochs higher-better and repack_p99_s lower-better.
+
+    Env knobs: BENCH_CHURN_NODES (4), BENCH_CHURN_TURNS (700),
+    BENCH_CHURN_SEED (0), BENCH_CHURN_REPACKS (30).
+    """
+    tpu_ok = probe_tpu()
+    import jax
+
+    if not tpu_ok:
+        jax.config.update("jax_platforms", "cpu")
+    platform = jax.devices()[0].platform
+    log(f"[env] platform={platform} devices={len(jax.devices())}")
+
+    from tpu_swirld.membership.engine import run_dynamic
+    from tpu_swirld.membership.repack import repack_packer
+    from tpu_swirld.membership.sim import churn_schedule
+    from tpu_swirld.packing import Packer
+
+    n_nodes = int(os.environ.get("BENCH_CHURN_NODES", "4"))
+    turns = int(os.environ.get("BENCH_CHURN_TURNS", "700"))
+    seed = int(os.environ.get("BENCH_CHURN_SEED", "0"))
+    n_repacks = int(os.environ.get("BENCH_CHURN_REPACKS", "30"))
+
+    t0 = time.time()
+    events, members, stake, _sim = churn_schedule(
+        n_nodes, seed=seed, turns=turns,
+    )
+    log(f"[churn] {n_nodes} members / {len(events)} events "
+        f"({time.time()-t0:.1f}s gossip gen)")
+
+    # warm (jit compiles in the repack stage), then time the driver
+    run_dynamic(events, members, stake, engine="incremental", chunk=64)
+    t0 = time.time()
+    res = run_dynamic(events, members, stake, engine="incremental",
+                      chunk=64)
+    dt = time.time() - t0
+    evps = len(events) / dt
+    epochs = res.epochs
+    log(f"[churn] {evps:.0f} ev/s, {epochs} epochs, "
+        f"{res.restatements} restatements, {len(res.order)} decided")
+
+    # repack tail: fresh packer per trial so each sample pays the full
+    # epoch-boundary cost (registry extension + stake swap + device pad)
+    samples = []
+    for _ in range(max(1, n_repacks)):
+        packer = Packer(list(members), list(stake))
+        for epoch in res.ledger.epochs[1:]:
+            samples.append(repack_packer(packer, epoch).seconds)
+    samples.sort()
+    p99 = samples[min(len(samples) - 1, int(len(samples) * 0.99))]
+    log(f"[churn] repack p99 {p99*1e3:.2f} ms over {len(samples)} samples")
+
+    out = {
+        "metric": "churn_evps",
+        "value": round(evps, 1),
+        "unit": "events/sec through the epoch-aware driver",
+        "platform": platform,
+        "churn": {
+            "evps": round(evps, 1),
+            "repack_p99_s": round(p99, 6),
+            "epochs": epochs,
+            "decided": len(res.order),
+            "restatements": res.restatements,
+            "repack_samples": len(samples),
+            "n_nodes": n_nodes,
+            "turns": turns,
+            "events": len(events),
+        },
+        "lint": lint_stamp(),
+        "mc": mc_stamp(),
+        "scale_audit": scale_audit_stamp(),
+    }
+    print(json.dumps(out), flush=True)
+    if epochs < 3:
+        log(f"[churn] FAIL: schedule decided only {epochs} epochs (< 3)")
+        sys.exit(1)
+
+
 def run_cluster():
     """--cluster: real-process loopback cluster throughput + latency.
 
@@ -1162,6 +1254,15 @@ def main(argv=None):
         "must shed load (exit 1 on any verdict failure or zero sheds)",
     )
     ap.add_argument(
+        "--churn", action="store_true",
+        help="run the dynamic-membership churn leg (a decided leave + "
+        "join over one gossip schedule through the epoch-aware driver) "
+        "and stamp churn.{evps, repack_p99_s, epochs} "
+        "(BENCH_CHURN_* overrides); bench_compare.py gates evps/epochs "
+        "higher-better and repack p99 lower-better; exit 1 if the "
+        "schedule decides fewer than 3 epochs",
+    )
+    ap.add_argument(
         "--soak", action="store_true",
         help="run the composed production-day soak (per-link TCP fault "
         "proxies, heavy-tailed traffic, crash + partition + equivocation "
@@ -1172,6 +1273,8 @@ def main(argv=None):
     args = ap.parse_args(argv)
     if args.soak:
         run_soak()
+    elif args.churn:
+        run_churn()
     elif args.cluster:
         run_cluster()
     elif args.chaos_overhead:

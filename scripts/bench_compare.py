@@ -100,6 +100,16 @@ EXTRA_KEYS = [
     # LOWER is better; a driver change that adds host work or transfer
     # stalls per chunk regresses it even when evps holds
     ("stream.dispatch_overhead_s", False),
+    # dynamic-membership churn artifacts (bench.py --churn): events/sec
+    # through the epoch-aware driver over a multi-epoch schedule (higher
+    # is better — a restatement or ledger-bookkeeping slowdown shows up
+    # here first), the p99 member-axis repack latency at an epoch
+    # boundary (LOWER is better — repack is on the live ingest path),
+    # and the epoch count (higher is better: a silently-undecided
+    # membership tx would *raise* evps while breaking the semantics)
+    ("churn.evps", True),
+    ("churn.repack_p99_s", False),
+    ("churn.epochs", True),
 ]
 
 #: artifacts whose tracing overhead exceeded this ratio are refused —

@@ -48,6 +48,10 @@ def main(argv=None) -> int:
     ap.add_argument("--mutate", choices=sorted(soak.MUTATIONS),
                     default=None,
                     help="inject a seeded defect; the verdict must go red")
+    ap.add_argument("--membership", action="store_true",
+                    help="DynamicNode cluster + a MembershipWindow "
+                         "(restake tx) at 30%% of the horizon — the "
+                         "verdict gains an epochs-decided gate")
     ap.add_argument("--no-shrink", action="store_true",
                     help="skip ddmin schedule reduction on a red verdict")
     ap.add_argument("--replay", default=None, metavar="DOC",
@@ -83,8 +87,16 @@ def main(argv=None) -> int:
         elif args.smoke:
             overrides["horizon_s"] = 7.0
         spec = soak.default_spec(workdir, **overrides)
+        schedule = soak.smoke_schedule(spec)
+        if args.membership:
+            schedule = schedule + (
+                soak.MembershipWindow(
+                    at_s=spec.horizon_s * 0.3, action="restake",
+                    member=1, stake=3,
+                ),
+            )
         spec = dataclasses.replace(
-            spec, schedule=soak.smoke_schedule(spec),
+            spec, schedule=schedule, dynamic=args.membership,
         )
         verdict = soak.run_soak(spec)
         if not verdict["ok"] and not args.no_shrink:

@@ -61,6 +61,7 @@ def test_registry_names():
         "fork_bomb_overbudget",
         "horizon_storm",
         "overflow_storm",
+        "membership_churn",
     ]
 
 

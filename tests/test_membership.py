@@ -1,0 +1,476 @@
+"""Dynamic membership: consensus-agreed MemberEpoch reconfiguration.
+
+Covers the `tpu_swirld/membership/` subsystem end to end:
+
+- the ``MTX1`` wire format (total decode, strict-once-magic-matches);
+- the epoch ledger's union-registry + functional-update invariants and
+  its tamper-evident checkpoint meta round trip;
+- the single-epoch regression pin — every engine's dynamic driver is
+  bit-identical to its unmodified native path when no membership tx
+  decides;
+- cross-engine parity on a schedule with ≥ 2 epoch transitions and a
+  fork pair straddling an activation boundary;
+- restatement determinism — the final state is a pure function of the
+  DAG, independent of ingest granularity and arrival order;
+- checkpoint restore re-deriving the active epoch and refusing a
+  tampered membership header;
+- the soak schedule's ``MembershipWindow`` dict round trip (ddmin
+  composability) and the membership gauges;
+- SW002/SW007 lint-scope pins over ``membership/``.
+
+The join→attack→vote-out chaos acceptance rides
+``tests/test_chaos.py``-style scenario plumbing in
+:func:`test_membership_churn_scenario`.
+"""
+
+import json
+import struct
+
+import pytest
+
+from tpu_swirld import crypto
+from tpu_swirld.membership import (
+    EpochLedger,
+    MemberEpoch,
+    MembershipTx,
+    JOIN,
+    LEAVE,
+    RESTAKE,
+    decode_tx,
+    encode_tx,
+    join_payload,
+    leave_payload,
+    restake_payload,
+)
+from tpu_swirld.membership.engine import ENGINES, run_all_engines, run_dynamic
+from tpu_swirld.membership.sim import (
+    churn_schedule,
+    make_dynamic_simulation,
+    sim_member,
+)
+from tpu_swirld.oracle.event import Event
+
+
+# ------------------------------------------------------------ wire format
+
+
+def test_mtx_roundtrip():
+    pk = b"\x01" * 32
+    for payload, kind, stake in [
+        (join_payload(pk, 7), JOIN, 7),
+        (leave_payload(pk), LEAVE, 0),
+        (restake_payload(pk, 3), RESTAKE, 3),
+    ]:
+        tx = decode_tx(payload)
+        assert tx == MembershipTx(kind, pk, stake)
+        assert encode_tx(tx) == payload
+
+
+def test_mtx_decode_is_total():
+    """Foreign payloads are opaque data; payloads that CLAIM the magic
+    and are malformed must be None, never a half-parsed change."""
+    pk = b"\x02" * 32
+    good = join_payload(pk, 1)
+    assert decode_tx(b"") is None
+    assert decode_tx(b"client-tx-bytes") is None
+    assert decode_tx(b"TXB1" + good[4:]) is None      # wrong magic
+    assert decode_tx(good[:-1]) is None               # truncated
+    assert decode_tx(good + b"\x00") is None          # trailing bytes
+    assert decode_tx(b"MTX1" + bytes([9, 32]) + pk
+                     + struct.pack("<I", 1)) is None  # unknown kind
+    # a zero-stake JOIN is a no-op by definition; LEAVE must carry 0
+    assert decode_tx(b"MTX1" + bytes([JOIN, 32]) + pk
+                     + struct.pack("<I", 0)) is None
+    assert decode_tx(b"MTX1" + bytes([LEAVE, 32]) + pk
+                     + struct.pack("<I", 5)) is None
+
+
+def test_mtx_encode_bounds():
+    pk = b"\x03" * 32
+    with pytest.raises(ValueError):
+        encode_tx(MembershipTx(9, pk, 1))
+    with pytest.raises(ValueError):
+        encode_tx(MembershipTx(JOIN, pk, 1 << 32))
+    with pytest.raises(ValueError):
+        encode_tx(MembershipTx(JOIN, b"", 1))
+
+
+# ------------------------------------------------------------ epoch ledger
+
+
+def _keys(n):
+    return [crypto.keypair(b"ledger-%d" % i)[0] for i in range(n)]
+
+
+def test_ledger_union_registry():
+    """Joins append rows, leaves zero stake but keep the row: epoch k's
+    member list is always a prefix of epoch k+1's."""
+    members = _keys(3)
+    led = EpochLedger.genesis(members, [1, 1, 1])
+    assert len(led.epochs) == 1
+    assert led.epochs[0].epoch_id == 0
+
+    joiner = crypto.keypair(b"ledger-join")[0]
+    led2 = led.apply(
+        decode_tx(join_payload(joiner, 2)), activation=9, carrier=b"c1"
+    )
+    assert led is not led2 and len(led.epochs) == 1  # functional update
+    head = led2.head
+    assert head.epoch_id == 1
+    assert head.members == tuple(members) + (joiner,)
+    assert head.stake == (1, 1, 1, 2)
+
+    led3 = led2.apply(
+        decode_tx(leave_payload(members[1])), activation=24, carrier=b"c2"
+    )
+    head = led3.head
+    # row kept, stake zeroed; indices stable forever
+    assert head.members == tuple(members) + (joiner,)
+    assert head.stake == (1, 0, 1, 2)
+    assert head.members_active == 3
+    assert head.total_stake == 4
+    for lo, hi in zip(led3.epochs, led3.epochs[1:]):
+        assert hi.members[: len(lo.members)] == lo.members
+        assert hi.activation_round > lo.activation_round
+
+    # round addressing: genesis below first activation, head after
+    assert led3.epoch_at(0).epoch_id == 0
+    assert led3.epoch_at(led3.epochs[1].activation_round).epoch_id == 1
+    assert led3.epoch_at(10**6) is led3.head
+    # applied-carrier dedup: re-applying the same carrier is a no-op
+    led4 = led3.apply(
+        decode_tx(leave_payload(members[1])), activation=34, carrier=b"c2"
+    )
+    assert led4.same_epochs(led3)
+
+
+def test_ledger_meta_tamper_refused():
+    members = _keys(2)
+    led = EpochLedger.genesis(members, [2, 2]).apply(
+        decode_tx(restake_payload(members[0], 5)), activation=7, carrier=b"c"
+    )
+    meta = led.to_meta()
+    assert EpochLedger.from_meta(json.loads(json.dumps(meta))).same_epochs(
+        led
+    )
+    # edit an epoch without re-stamping the digest: refused
+    bad = json.loads(json.dumps(meta))
+    bad["epochs"][1]["stake"][0] = 99
+    with pytest.raises(ValueError):
+        EpochLedger.from_meta(bad)
+
+
+# -------------------------------------------------- single-epoch pin
+
+
+@pytest.mark.parametrize("engine", [e for e in ENGINES if e != "oracle"])
+def test_single_epoch_pin(engine):
+    """No decided membership tx: every engine's dynamic driver must be
+    bit-identical to the unmodified native engine (run_dynamic's
+    cross_check raises on any divergence)."""
+    from tpu_swirld.sim import make_simulation
+
+    sim = make_simulation(4, seed=2)
+    sim.run(100)
+    node = sim.nodes[0]
+    events = [node.hg[e] for e in node.order_added]
+    res = run_dynamic(
+        events, list(node.members), list(node.config.stakes()),
+        node.config, engine=engine, chunk=32, cross_check=True,
+    )
+    assert res.single_epoch
+    assert len(res.ledger.epochs) == 1
+    assert res.restatements == 0
+    assert res.native_order == res.order
+    assert len(res.order) > 0
+
+
+# ------------------------------------------- multi-epoch engine parity
+
+
+def _fork_pair(sim, victim):
+    """Mint a sibling of ``victim``'s newest event (same parents, same
+    seq, different payload) and feed it to every node holding both
+    parents — a by_seq fork group straddling whatever epoch boundary the
+    caller timed it against."""
+    probe = max(sim.nodes, key=lambda x: len(x.hg))
+    chain = probe.member_events.get(victim.pk, [])
+    if len(chain) < 2:
+        return 0
+    newest = probe.hg[chain[-1]]
+    if not newest.p:
+        return 0
+    sp, op = newest.p
+    sib = Event(
+        d=b"fork:%d" % len(chain), p=(sp, op), t=newest.t + 1, c=victim.pk
+    ).signed(victim.sk)
+    fed = 0
+    for node in sim.nodes:
+        if sib.id in node.hg or sp not in node.hg or op not in node.hg:
+            continue
+        if node.add_event(sib):
+            node.consensus_pass([sib.id])
+            fed += 1
+    return fed
+
+
+def test_cross_engine_parity_two_transitions_with_fork():
+    """≥ 2 epoch transitions (restake then vote-out leave) with fork
+    pairs straddling the second activation boundary: all five engines
+    bit-identical on order + rounds, streaming archive rows span the
+    epochs, mesh re-pins per epoch."""
+    sim = make_dynamic_simulation(4, seed=5)
+    victim = sim.nodes[3]
+    sim.tx_schedule[12] = restake_payload(sim_member(4, 5, 2), 5)
+    sim.run(90)
+    fed = _fork_pair(sim, victim)
+    # the LEAVE rides a direct honest sync so the forker can't carry
+    # its own removal
+    sim.clock[0] += 1
+    new_ids = sim.nodes[0].sync(sim.nodes[1].pk, leave_payload(victim.pk))
+    sim.nodes[0].consensus_pass(new_ids)
+    sim.run(40)
+    fed += _fork_pair(sim, victim)
+    sim.run(160)
+
+    node0 = max(sim.nodes, key=lambda x: len(x.consensus))
+    assert fed > 0
+    assert len(node0.ledger.epochs) >= 3
+    assert node0.ledger.head.stake_of(victim.pk) == 0
+    assert node0.forks_detected > 0
+
+    events = [node0.hg[e] for e in node0.order_added]
+    results = run_all_engines(
+        events, node0._genesis_members, node0._genesis_stake,
+        sim.config, chunk=32,
+    )
+    assert set(results) == set(ENGINES)
+    ref = results["oracle"]
+    assert len(ref.ledger.epochs) >= 3
+    for res in results.values():
+        assert res.order == ref.order
+        assert res.rounds == ref.rounds
+        assert res.ledger.same_epochs(ref.ledger)
+    # streaming rows are epoch-stamped and actually span the epochs
+    stamped = results["streaming"].archive_epochs
+    assert stamped is not None and len(stamped) == len(ref.order)
+    assert len({epoch for _, epoch in stamped}) >= 2
+    # mesh re-pins the member axis once per epoch
+    pins = results["mesh"].shard_pins
+    assert pins is not None and len(pins) == len(ref.ledger.epochs)
+    assert len(pins[-1]) == len(ref.ledger.head.members)
+    # every device engine repacked once per post-genesis epoch
+    for e in ("batch", "incremental", "streaming", "mesh"):
+        assert len(results[e].repacks) == len(ref.ledger.epochs) - 1
+
+
+def test_restatement_determinism():
+    """Batch ingest assigns every round before any membership tx
+    decides, forcing the restatement path; the result must still be
+    bit-identical to the per-event oracle replay, and independent of a
+    different (topologically valid) arrival order."""
+    events, members, stake, sim = churn_schedule(4, seed=3, turns=420)
+    oracle = run_dynamic(
+        events, members, stake, sim.config, engine="oracle"
+    )
+    batch = run_dynamic(
+        events, members, stake, sim.config, engine="batch",
+        cross_check=False,
+    )
+    assert len(oracle.ledger.epochs) >= 3
+    assert batch.restatements >= 1
+    assert batch.order == oracle.order
+    assert batch.rounds == oracle.rounds
+    assert batch.ledger.same_epochs(oracle.ledger)
+
+    # alternative topo order: Kahn's algorithm draining the ready set in
+    # reversed (creator, timestamp) order — same DAG, different arrival
+    # sequence
+    import collections
+
+    by_id = {e.id: e for e in events}
+    children = collections.defaultdict(list)
+    indeg = {}
+    for ev in events:
+        indeg[ev.id] = sum(1 for p in (ev.p or ()) if p in by_id)
+        for p in ev.p or ():
+            if p in by_id:
+                children[p].append(ev.id)
+    ready = [e.id for e in events if indeg[e.id] == 0]
+    alt = []
+    while ready:
+        ready.sort(key=lambda i: (by_id[i].c, by_id[i].t), reverse=True)
+        x = ready.pop(0)
+        alt.append(by_id[x])
+        for ch in children[x]:
+            indeg[ch] -= 1
+            if indeg[ch] == 0:
+                ready.append(ch)
+    assert [e.id for e in alt] != [e.id for e in events]
+    again = run_dynamic(
+        alt, members, stake, sim.config, engine="oracle"
+    )
+    assert again.order == oracle.order
+    assert again.rounds == oracle.rounds
+    assert again.ledger.same_epochs(oracle.ledger)
+
+
+# --------------------------------------------------------- checkpointing
+
+
+def test_checkpoint_epoch_ledger_roundtrip_and_tamper(tmp_path):
+    from tpu_swirld.checkpoint import load_node, save_node
+    from tpu_swirld.membership.dynamic import DynamicNode
+
+    events, members, stake, sim = churn_schedule(4, seed=1, turns=420)
+    node = sim.nodes[0]
+    assert len(node.ledger.epochs) >= 2
+    path = str(tmp_path / "dyn.ckpt")
+    save_node(path, node)
+
+    restored = load_node(path, sk=node.sk, pk=node.pk, network={})
+    assert isinstance(restored, DynamicNode)
+    assert restored.ledger.same_epochs(node.ledger)
+    assert restored.consensus == node.consensus
+    assert restored.membership_epoch == node.membership_epoch
+
+    # tamper: re-stamp a *consistent* but wrong ledger into the header —
+    # the replay-derived epoch sequence is the only accepted truth
+    with open(path, "rb") as f:
+        data = f.read()
+    (hlen,) = struct.unpack_from("<I", data, 4)
+    meta = json.loads(data[8 : 8 + hlen].decode())
+    head = node.ledger.head
+    forged = EpochLedger(
+        epochs=node.ledger.epochs[:-1] + (
+            MemberEpoch(
+                epoch_id=head.epoch_id,
+                activation_round=head.activation_round,
+                members=head.members,
+                stake=(99,) + head.stake[1:],
+            ),
+        )
+    )
+    meta["membership"].update(forged.to_meta())
+    header = json.dumps(meta).encode()
+    bad = str(tmp_path / "tampered.ckpt")
+    with open(bad, "wb") as f:
+        f.write(b"SWCK" + struct.pack("<I", len(header)) + header
+                + data[8 + hlen:])
+    with pytest.raises(ValueError, match="epoch ledger"):
+        load_node(bad, sk=node.sk, pk=node.pk, network={})
+
+
+# ----------------------------------------------- soak window + gauges
+
+
+def test_membership_window_dict_roundtrip():
+    from tpu_swirld.soak import (
+        MembershipWindow, window_from_dict, window_to_dict,
+    )
+
+    for w in [
+        MembershipWindow(at_s=2.5, action="restake", member=1, stake=3),
+        MembershipWindow(at_s=4.0, action="leave", member=2),
+    ]:
+        d = window_to_dict(w)
+        assert window_from_dict(json.loads(json.dumps(d))) == w
+
+
+def test_node_gauges_membership_surface():
+    from tpu_swirld.metrics import node_gauges
+    from tpu_swirld.sim import make_simulation
+
+    static = make_simulation(4, seed=0)
+    static.run(10)
+    g = node_gauges(static.nodes[0])
+    # static nodes report the trivial single-epoch values (genesis id 0)
+    assert g["membership_epoch"] == 0
+    assert g["members_active"] == 4
+    assert g["stake_total"] == static.nodes[0].tot_stake
+
+    dyn = make_dynamic_simulation(4, seed=0)
+    dyn.tx_schedule[10] = restake_payload(sim_member(4, 0, 1), 4)
+    dyn.run(150)
+    node = max(dyn.nodes, key=lambda x: len(x.consensus))
+    g = node_gauges(node)
+    assert g["membership_epoch"] == node.ledger.head.epoch_id
+    assert g["stake_total"] == node.ledger.head.total_stake
+
+
+@pytest.mark.smoke
+def test_obs_report_membership_section():
+    """The report CLI renders the membership gauges in their own
+    section (single-trace view) and per-node rows (fleet view)."""
+    from tpu_swirld.obs.registry import Registry
+    from tpu_swirld.obs.report import render_cluster_report, render_report
+    from tpu_swirld.metrics import node_gauges
+    from tpu_swirld.sim import make_simulation
+
+    sim = make_simulation(4, seed=0)
+    sim.run(10)
+    reg = Registry()
+    node_gauges(sim.nodes[0], registry=reg)
+    events = []
+    for s in reg.to_samples():
+        args = dict(s.get("labels") or {})
+        args["value"] = s["value"]
+        events.append({"ph": "C", "name": s["name"], "args": args})
+    out = render_report(events)
+    assert "== membership (epoch / active members / stake) ==" in out
+    section = out.split("== membership")[1]
+    for name in ("node_membership_epoch", "node_members_active",
+                 "node_stake_total"):
+        assert name in section
+
+
+def test_obs_cluster_report_membership_rows(tmp_path):
+    from tpu_swirld.obs.report import render_cluster_report
+
+    with open(tmp_path / "node-0.report.json", "w") as f:
+        json.dump({"node": 0, "membership_epoch": 2,
+                   "membership_epochs": 3, "members_active": 5,
+                   "stake_total": 9, "decided": []}, f)
+    out = render_cluster_report(str(tmp_path))
+    assert "== membership (per node) ==" in out
+    assert "epoch=2 epochs_decided=3 members_active=5 stake_total=9" in out
+
+
+# --------------------------------------------------- lint-scope pinning
+
+
+@pytest.mark.analysis
+def test_sw002_scope_covers_membership():
+    from tpu_swirld.analysis import check_source
+
+    bad = 's = {b"a", b"b"}\nfor x in s:\n    pass\n'
+    findings = check_source(bad, module_path="membership/epoch.py")
+    assert "SW002" in [f.rule for f in findings]
+
+
+@pytest.mark.analysis
+def test_sw007_scope_covers_membership():
+    from tpu_swirld.analysis import check_source
+
+    bad = "def f(x):\n    assert x > 0\n    return x\n"
+    findings = check_source(bad, module_path="membership/dynamic.py")
+    assert "SW007" in [f.rule for f in findings]
+
+
+# -------------------------------------------------- chaos acceptance
+
+
+@pytest.mark.chaos
+def test_membership_churn_scenario(tmp_path):
+    """join → equivocation storm → voted out, with all five engines
+    bit-identical on the surviving DAG (the full acceptance storm; the
+    fast tier covers each gate piecewise above)."""
+    from tpu_swirld.adversary import SCENARIOS
+
+    v = SCENARIOS["membership_churn"](str(tmp_path))
+    assert v["ok"], v
+    m = v["membership"]
+    assert m["joined"] and m["voted_out"]
+    assert m["epochs"] >= 3
+    assert m["witness_gating_ok"]
+    assert v["engines"]["parity"]

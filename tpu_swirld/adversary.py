@@ -544,3 +544,18 @@ def _run_overflow_storm(
     return run_overflow_storm(
         seed=4 if seed is None else seed, flightrec=flightrec
     )
+
+
+@register_scenario("membership_churn")
+def _run_membership_churn(
+    ckpt_dir: str, seed: Optional[int] = None, engine: str = "incremental",
+    metrics=None, tracer=None, flightrec=None,
+) -> Dict:
+    """Dynamic membership under attack: an adversary JOINs by decided
+    tx, mounts an equivocation storm across the vote-out boundary, and
+    is removed by a decided LEAVE — stake zeroed, witness power gone."""
+    from tpu_swirld.chaos import run_membership_churn
+
+    return run_membership_churn(
+        ckpt_dir, seed=11 if seed is None else seed, flightrec=flightrec,
+    )

@@ -675,6 +675,31 @@ def _m_consensus(env):
 
 
 # --------------------------------------------------------------------------
+# dynamic membership (epoch-boundary member-axis repack)
+
+
+def _mb_repack(env):
+    """The :func:`tpu_swirld.membership.repack.repack_stage` boundary at
+    its worst case: one joiner extends the member axis M -> M+1, and the
+    member table is as tall as a single creator could make it (K = N —
+    one member authored every event).  Values are packed event indices
+    (``-1`` padding), so the claim is they stay inside int32 at the
+    envelope's event count; stake rides the config-declared cap."""
+    from tpu_swirld.membership import repack as MR
+
+    d = _dims(env)
+    N, M = d["N"], d["M"]
+    return (
+        MR.repack_stage,
+        dict(n_members_new=M + 1),
+        [
+            _arr((M, N), _I32, -1, N - 1),       # member_table
+            _arr((M + 1,), _I32, 0, d["smax"]),  # stake_new
+        ],
+    )
+
+
+# --------------------------------------------------------------------------
 # catalog
 
 
@@ -736,6 +761,10 @@ CATALOG: List[StageSpec] = [
     StageSpec("inc.prune", "pipeline.inc_prune", _INC, _i_prune),
     StageSpec("inc.prune_noforks", "pipeline.inc_prune",
               _INC, _i_prune_noforks),
+    # dynamic membership: every device engine repacks at epoch
+    # boundaries (membership.repack.repack_packer dispatches the stage)
+    StageSpec("membership.repack", "membership.repack_stage",
+              ("batch",) + _INC, _mb_repack),
     # mesh kernels
     StageSpec("mesh.ssm_block_row", "pipeline.ssm_block_mesh",
               ("mesh",), _m_ssm_block_row),

@@ -34,6 +34,10 @@ Catalog
   multi-event seq group, and only attacker members), the equivocation
   counter counts fork groups, and the 3f budget trips iff the number of
   forked creators exceeds ``f = (n-1)//3``.
+- ``epoch-purity`` (state, dynamic membership): each honest node's epoch
+  ledger equals the canonical reconstruction from its own decided prefix
+  (canonical activation rule), and no recorded fame tally counted stake
+  from any epoch other than the one governing its voting round.
 - ``counter-consistency`` (state): over a reliable transport every
   pathology counter (bad replies/requests, retries, circuit opens,
   withholding, capped branches, quarantines) is zero and the orphan
@@ -277,6 +281,61 @@ def check_fork_budget(world: World, state: MCState,
     return out
 
 
+def check_epoch_purity(world: World, state: MCState,
+                       nodes: Dict[int, Node]) -> List[Violation]:
+    """Dynamic membership: (1) each honest node's epoch ledger equals the
+    canonical reconstruction from its own decided prefix through the
+    canonical activation rule — any skew in the node's incremental
+    adoption path (e.g. an off-by-one activation round) is a detectable
+    divergence; (2) every recorded fame tally counted stake from exactly
+    the epoch governing its voting round — no decision mixes stake from
+    two epochs.  Vacuous for static-membership worlds."""
+    from tpu_swirld.membership.epoch import (
+        DEFAULT_DELAY, ledger_from_decided,
+    )
+
+    out: List[Violation] = []
+    for i, node in nodes.items():
+        ledger = getattr(node, "ledger", None)
+        if ledger is None:
+            return []
+        delay = getattr(node, "membership_delay", DEFAULT_DELAY)
+        canon = ledger_from_decided(
+            (
+                (x, node.hg[x].d, node.round_received[x])
+                for x in node.consensus
+            ),
+            node._genesis_members, node._genesis_stake, delay,
+        )
+        if not canon.same_epochs(ledger):
+            got = [
+                (e.epoch_id, e.activation_round, e.stake)
+                for e in ledger.epochs
+            ]
+            want = [
+                (e.epoch_id, e.activation_round, e.stake)
+                for e in canon.epochs
+            ]
+            out.append(Violation(
+                "epoch-purity", i,
+                f"honest {i}'s epoch ledger diverges from the canonical "
+                f"reconstruction of its decided prefix: {got} vs "
+                f"canonical {want}",
+            ))
+            continue
+        for x, ry, tallied in getattr(node, "fame_epoch_log", []):
+            governing = ledger.epoch_at(ry - 1).epoch_id
+            if tallied != governing:
+                out.append(Violation(
+                    "epoch-purity", i,
+                    f"fame of {_short(x)} tallied at voting round {ry} "
+                    f"with epoch {tallied} stake but epoch {governing} "
+                    f"governs round {ry - 1} — decision mixes epochs",
+                ))
+                break
+    return out
+
+
 def check_counters(world: World, state: MCState,
                    nodes: Dict[int, Node]) -> List[Violation]:
     out: List[Violation] = []
@@ -384,6 +443,9 @@ INVARIANTS: List[Invariant] = [
     Invariant("fork-budget", "state", check_fork_budget,
               "fork ledger == ground truth from by_seq; 3f budget trips "
               "iff forked creators exceed f"),
+    Invariant("epoch-purity", "state", check_epoch_purity,
+              "epoch ledger equals the canonical reconstruction from the "
+              "decided prefix; no fame tally mixes stake from two epochs"),
     Invariant("counter-consistency", "state", check_counters,
               "all pathology counters zero and orphans drained over a "
               "reliable transport"),

@@ -80,6 +80,28 @@ def _disable_fork_budget_cls() -> type:
     return NoBudgetNode
 
 
+def _dynamic_node_cls() -> type:
+    from tpu_swirld.membership.dynamic import DynamicNode
+
+    return DynamicNode
+
+
+def _epoch_skew_cls() -> type:
+    from tpu_swirld.membership.dynamic import DynamicNode
+
+    class EpochSkewNode(DynamicNode):
+        """Epoch activation off by one round: a decided membership tx
+        takes effect one round later than the canonical rule — every
+        honest node still *agrees* (the bug is deterministic), which is
+        exactly why prefix-agreement can't catch it; only the epoch-
+        purity invariant's canonical reconstruction does."""
+
+        def _activation_round(self, round_received: int) -> int:
+            return super()._activation_round(round_received) + 1
+
+    return EpochSkewNode
+
+
 def _skip_horizon_cls() -> type:
     class SkipHorizonNode(Node):
         """Quarantines witnesses that land below the node's current
@@ -128,6 +150,21 @@ MUTATIONS: Dict[str, Mutation] = {
             # costs three events: two on one branch, one on the other
             world_kwargs=dict(n_honest=2, n_forkers=2, events=6),
             make_node_cls=_disable_fork_budget_cls,
+        ),
+        Mutation(
+            name="epoch-skew",
+            expected_invariant="epoch-purity",
+            describe="membership-tx activation round off by one",
+            # a restake tx rides member 0's genesis; the ledger diverges
+            # from the canonical reconstruction the moment the genesis
+            # decides (~23 events in a 3-member gossip ladder) — budget
+            # 30 leaves the weighted hunt slack for non-ladder detours
+            world_kwargs=dict(
+                n_honest=3, n_forkers=0, events=30,
+                genesis_mtx={0: ("restake", 1, 3)},
+                observer_cls=_dynamic_node_cls(),
+            ),
+            make_node_cls=_epoch_skew_cls,
         ),
         Mutation(
             name="skip-horizon",

@@ -123,6 +123,8 @@ class World:
         withhold: bool = False,
         node_cls: Optional[type] = None,
         config: Optional[SwirldConfig] = None,
+        observer_cls: Optional[type] = None,
+        genesis_mtx: Optional[Dict[int, tuple]] = None,
     ):
         if n_honest < 1:
             raise ValueError("need at least one honest role")
@@ -132,6 +134,16 @@ class World:
         self.seed = seed
         self.withhold = withhold
         self.node_cls = node_cls or Node
+        # ground-truth observer for the union replay: vanilla by default;
+        # dynamic-membership worlds pass DynamicNode so the observer
+        # interprets membership transactions the way honest nodes do
+        # (NEVER the mutated class — the observer is the reference)
+        self.observer_cls = observer_cls or Node
+        # genesis-carried membership transactions, carrier member index ->
+        # ("restake", member, stake) | ("leave", member) | ("join", stake);
+        # riding the geneses keeps them in every exploration branch's
+        # history, so the transition memo stays sound
+        self.genesis_mtx = dict(genesis_mtx or {})
         n_members = n_honest + n_forkers
         self.config = config or SwirldConfig(n_members=n_members, seed=seed)
         if self.config.n_members != n_members:
@@ -158,7 +170,9 @@ class World:
         self._geneses: List[bytes] = []
         for i in range(n_members):
             pk, sk = self.keys[i]
-            g = Event(d=b"", p=(), t=0, c=pk).signed(sk)
+            g = Event(
+                d=self._genesis_payload(i), p=(), t=0, c=pk
+            ).signed(sk)
             self.events[g.id] = g
             self._geneses.append(g.id)
         self._cache: "OrderedDict[Tuple[int, tuple], Node]" = OrderedDict()
@@ -168,6 +182,26 @@ class World:
         # branch tip for branch actors) — global states sharing those
         # locals share the transition, so re-executions are table hits
         self._tmemo: Dict[tuple, Tuple[tuple, tuple]] = {}
+
+    def _genesis_payload(self, i: int) -> bytes:
+        spec = self.genesis_mtx.get(i)
+        if spec is None:
+            return b""
+        from tpu_swirld.membership import txs as mtx
+
+        kind = spec[0]
+        if kind == "restake":
+            return mtx.restake_payload(
+                self.members[int(spec[1])], int(spec[2])
+            )
+        if kind == "leave":
+            return mtx.leave_payload(self.members[int(spec[1])])
+        if kind == "join":
+            jpk, _sk = crypto.keypair(
+                b"mc-joiner-%d-%d" % (self.seed, i)
+            )
+            return mtx.join_payload(jpk, int(spec[1]))
+        raise ValueError(f"unknown genesis_mtx kind {kind!r}")
 
     # ------------------------------------------------------------- state
 
@@ -355,7 +389,7 @@ class World:
                 lambda e: [p for p in self.events[e].p],
             )
             pk, sk = self.keys[0]
-            node = Node(
+            node = self.observer_cls(
                 sk=sk, pk=pk, network={}, members=self.members,
                 config=self.config, create_genesis=False, network_want={},
             )

@@ -12,7 +12,8 @@ Catalog:
   seeded ``random.Random`` / ``np.random.default_rng(seed)`` instance.
 - **SW002 unordered-iter** — no hash-order ``set`` iteration in the
   consensus-critical modules (``oracle/``, ``store/streaming.py``,
-  ``tpu/pipeline.py``, ``chaos.py``) without an explicit ``sorted()``.
+  ``tpu/pipeline.py``, ``chaos.py``, ``membership/``) without an
+  explicit ``sorted()``.
 - **SW003 wall-clock** — no ``time.time`` / ``time.sleep`` /
   ``datetime.now`` in the logical-time transport/retry layer.  Inside
   ``net/`` (the socket deployment edge, which legitimately needs real
@@ -33,7 +34,8 @@ Catalog:
   ``GUARDED_ATTRS`` frozenset.
 - **SW007 load-bearing-assert** — no ``assert`` statements in the
   production modules (``oracle/``, ``store/``, ``tpu/``,
-  ``transport.py``, ``parallel.py``, ``packing.py``): asserts vanish
+  ``transport.py``, ``parallel.py``, ``packing.py``,
+  ``membership/``): asserts vanish
   under ``python -O``; safety checks must be explicit raises (with a
   counter where useful).
 

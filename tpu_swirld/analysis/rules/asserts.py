@@ -33,7 +33,7 @@ class LoadBearingAssertRule(Rule):
     )
     scope = (
         "oracle/", "store/", "tpu/", "transport.py", "parallel.py",
-        "packing.py",
+        "packing.py", "membership/",
     )
 
     _FIX = (

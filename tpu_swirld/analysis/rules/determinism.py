@@ -179,6 +179,7 @@ class UnorderedIterRule(Rule):
         "adversary.py", "obs/finality.py", "obs/flightrec.py",
         "obs/cluster_trace.py", "obs/profile.py",
         "net/proxy.py", "net/traffic.py", "soak.py",
+        "membership/",
     )
 
     _FIX = (

@@ -62,6 +62,7 @@ def oracle_replay(
     members: List[bytes],
     config: SwirldConfig,
     observer_key,
+    node_cls: type = None,
 ) -> List[bytes]:
     """Fault-free ground truth for a union event store: a fresh observer
     ingests ``union`` (id -> Event) in deterministic topo order and
@@ -75,7 +76,10 @@ def oracle_replay(
         lambda e: [p for p in union[e].p],
     )
     pk, sk = observer_key
-    observer = Node(
+    # node_cls: a dynamic-membership population must be replayed by a
+    # DynamicNode observer — a static observer would keep genesis stake
+    # past decided epoch boundaries and diverge from every honest node
+    observer = (node_cls or Node)(
         sk=sk, pk=pk, network={}, members=members,
         config=config, create_genesis=False,
     )
@@ -792,6 +796,216 @@ def run_overflow_storm(seed: int = 4, flightrec=None) -> Dict:
         "fork_storm": fork_leg,
         "round_clamp": clamp_leg,
         "scenario": {"seed": seed, "name": "overflow_storm"},
+        "flightrec_dump": dump,
+    }
+
+
+def run_membership_churn(
+    ckpt_dir: str, seed: int = 11, flightrec=None,
+) -> Dict:
+    """Dynamic-membership acceptance storm: an adversary JOINS mid-run,
+    mounts an equivocation storm spanning the vote-out epoch boundary,
+    and is removed by a decided LEAVE transaction — the "voted out"
+    path.  Three phases over one dynamic-membership gossip population:
+
+    1. *admit*: a JOIN tx for a fresh key rides honest gossip, decides,
+       and activates; the joiner node comes online mid-run
+       (:func:`~tpu_swirld.membership.dynamic.joining_node`), bootstraps
+       from gossip, and gains stake at its epoch's activation round.
+    2. *attack*: the admitted member mints fork pairs (divergent events
+       at equal seq fed to different honest nodes) through the window in
+       which the honest members issue the LEAVE tx — so fork pairs
+       straddle the vote-out epoch's activation boundary.
+    3. *vote-out*: the LEAVE decides, the leaver's stake zeroes at the
+       activation round, and the storm loses all voting power: no event
+       it creates at or past activation is ever a witness.
+
+    Verdict gates: the join and leave epochs both decided (≥ 3 epochs);
+    forks detected by every honest node with the 3f budget silent (one
+    forked creator, f = 1); zero-stake witness gating post-activation;
+    honest prefix agreement; liveness THROUGH the churn (decisions
+    advance after vote-out); all five dynamic engine drivers
+    bit-identical on the surviving DAG; and a checkpoint of the densest
+    honest node round-trips with its epoch ledger verified.
+    """
+    from tpu_swirld import crypto as _crypto
+    from tpu_swirld.checkpoint import load_node, save_node
+    from tpu_swirld.membership.engine import run_all_engines
+    from tpu_swirld.membership.sim import make_dynamic_simulation
+    from tpu_swirld.membership.txs import join_payload, leave_payload
+    from tpu_swirld.oracle.event import Event as _Event
+
+    n = 4
+    apk, ask = _crypto.keypair(b"churn-adversary-%d" % seed)
+    sim = make_dynamic_simulation(n, seed=seed)
+    honest = list(sim.nodes)
+
+    # phase 1: a JOIN for the adversary key rides honest gossip
+    sim.tx_schedule[15] = join_payload(apk, 1)
+    sim.run(220)
+    adv = sim.add_joiner(ask, apk)
+    sim.run(120)
+    join_epochs = len(honest[0].ledger.epochs)
+    joined = apk in honest[0].member_index
+
+    def _mint_fork_pair() -> int:
+        """Equivocate: a sibling of the adversary's newest chain event
+        (same self-parent, same seq, different payload) is fed straight
+        to every honest node — the by_seq fork group forms wherever both
+        siblings land."""
+        probe = max(honest, key=lambda x: len(x.hg))
+        chain = probe.member_events.get(apk, [])
+        if len(chain) < 2:
+            return 0
+        newest = probe.hg[chain[-1]]
+        sp, op = newest.p if newest.p else (None, None)
+        if sp is None:
+            return 0
+        sib = _Event(
+            d=b"equivocate:%d" % len(chain),
+            p=(sp, op),
+            t=newest.t + 1,
+            c=apk,
+        ).signed(ask)
+        fed = 0
+        for node in honest:
+            if sib.id in node.hg or sp not in node.hg or op not in node.hg:
+                continue
+            if node.add_event(sib):
+                node.consensus_pass([sib.id])
+                fed += 1
+        return fed
+
+    # phase 2+3: the storm runs through the vote-out window — the LEAVE
+    # tx decides mid-storm, so fork-pair events land on both sides of
+    # the removal epoch's activation round.  The LEAVE is injected by a
+    # direct honest sync (not tx_schedule, whose random turn owner could
+    # be the adversary itself): honest member 0 votes the attacker out.
+    pairs_fed = 0
+    for i in range(30):
+        sim.run(12)
+        pairs_fed += _mint_fork_pair()
+        if i == 8:
+            sim.clock[0] += 1
+            new_ids = honest[0].sync(honest[1].pk, leave_payload(apk))
+            honest[0].consensus_pass(new_ids)
+    sim.run(150)
+
+    node0 = max(honest, key=lambda x: len(x.consensus))
+    epochs = node0.ledger.epochs
+    voted_out = (
+        len(epochs) > join_epochs
+        and node0.ledger.head.stake_of(apk) == 0
+    )
+    act = epochs[-1].activation_round if voted_out else None
+
+    # witness gating: no adversary event at/past the removal activation
+    # round is a witness on any honest node
+    gated = True
+    post_act_events = 0
+    if voted_out:
+        for node in honest:
+            for eid, w in node.is_witness.items():
+                if node.hg[eid].c != apk:
+                    continue
+                if node.round.get(eid, 0) >= act:
+                    post_act_events += 1
+                    if w:
+                        gated = False
+
+    forks = {
+        "pairs_fed": pairs_fed,
+        "forks_detected": min(x.forks_detected for x in honest),
+        "equivocations_detected": min(
+            x.equivocations_detected for x in honest
+        ),
+        "budget_exhausted": max(x.budget_exhausted for x in honest),
+    }
+
+    # safety: honest prefix agreement; liveness: decisions advanced past
+    # the vote-out activation
+    orders = [x.consensus for x in honest]
+    m = min(len(o) for o in orders)
+    prefix_agree = all(o[:m] == orders[0][:m] for o in orders)
+    decided_at_act = sum(
+        1 for x in node0.consensus if node0.round_received[x] < act
+    ) if voted_out else 0
+    liveness_ok = voted_out and len(node0.consensus) > decided_at_act
+
+    # cross-engine parity on the surviving DAG (fork pairs + 3 epochs)
+    events = [node0.hg[e] for e in node0.order_added]
+    try:
+        results = run_all_engines(
+            events, list(node0._genesis_members),
+            list(node0._genesis_stake), node0.config, chunk=64,
+        )
+        engines = {
+            "parity": True,
+            "decided": len(results["batch"].order),
+            "epochs": results["batch"].epochs,
+            "restatements": results["batch"].restatements,
+            "repacks": [s.to_dict() for s in results["batch"].repacks],
+            "archive_epochs_spanned": len({
+                e for _, e in results["streaming"].archive_epochs
+            }),
+            "mesh_repins": [len(p) for p in results["mesh"].shard_pins],
+        }
+    except AssertionError as exc:
+        engines = {"parity": False, "error": str(exc)}
+
+    # checkpoint: the epoch ledger must survive a save/load round trip
+    ckpt_path = os.path.join(ckpt_dir, "membership_churn.ckpt")
+    save_node(ckpt_path, node0)
+    try:
+        restored = load_node(ckpt_path, node0.sk, node0.pk, {}, {})
+        ckpt = {
+            "ok": bool(
+                restored.ledger.same_epochs(node0.ledger)
+                and restored.consensus == node0.consensus
+            ),
+            "epochs": len(restored.ledger.epochs),
+        }
+    except ValueError as exc:
+        ckpt = {"ok": False, "error": str(exc)}
+
+    ok = bool(
+        joined and voted_out and gated and post_act_events > 0
+        and forks["equivocations_detected"] > 0
+        and forks["budget_exhausted"] == 0
+        and prefix_agree and liveness_ok
+        and engines.get("parity") and engines.get("epochs", 0) >= 3
+        and ckpt["ok"]
+    )
+    dump = None
+    if flightrec is not None and not ok:
+        dump = flightrec.trigger(
+            "verdict_failed",
+            detail={"membership_churn": {
+                "joined": joined, "voted_out": voted_out, "gated": gated,
+            }},
+            decided_frontier={"decided": len(node0.consensus)},
+        )
+    return {
+        "ok": ok,
+        "scenario": {"seed": seed, "name": "membership_churn"},
+        "membership": {
+            "joined": joined,
+            "voted_out": voted_out,
+            "epochs": len(epochs),
+            "activation_round": act,
+            "witness_gating_ok": gated,
+            "adversary_events_post_activation": post_act_events,
+            "joiner_decided": len(adv.consensus),
+        },
+        "adversary": {"strategy": "membership_churn", **forks},
+        "safety": {"prefix_agree": prefix_agree},
+        "liveness": {
+            "decided": len(node0.consensus),
+            "decided_at_vote_out": decided_at_act,
+            "advanced_after_vote_out": liveness_ok,
+        },
+        "engines": engines,
+        "checkpoint": ckpt,
         "flightrec_dump": dump,
     }
 

@@ -138,6 +138,18 @@ def save_node(path: str, node: Node) -> None:
         "frontier": node._frozen_round,
         "order_digest": crypto.hash_bytes(b"".join(node.consensus)).hex(),
     }
+    ledger = getattr(node, "ledger", None)
+    if ledger is not None:
+        # dynamic membership: the epoch ledger rides the header so a
+        # restore can verify the replay re-derives the identical epoch
+        # sequence.  The node is rebuilt from the GENESIS member set —
+        # the registry (meta["members"] above) regrows from decided
+        # joins during replay, exactly as it grew live.
+        meta["membership"] = {
+            **ledger.to_meta(),
+            "genesis_members": [m.hex() for m in node._genesis_members],
+            "delay": node.membership_delay,
+        }
     header = json.dumps(meta).encode()
     # atomic replace: a process killed (kill -9) mid-checkpoint must
     # leave either the previous checkpoint or the new one intact — a
@@ -179,7 +191,16 @@ def load_node(
     cfg_dict["stake"] = tuple(cfg_dict["stake"])
     cfg = SwirldConfig(**cfg_dict)
     members = [bytes.fromhex(m) for m in meta["members"]]
-    node = Node(
+    membership = meta.get("membership")
+    node_cls = Node
+    if membership is not None:
+        from tpu_swirld.membership.dynamic import DynamicNode
+
+        node_cls = DynamicNode
+        # rebuild from the genesis member set; decided joins regrow the
+        # registry during replay
+        members = [bytes.fromhex(m) for m in membership["genesis_members"]]
+    node = node_cls(
         sk=sk, pk=pk, network=network, members=members, config=cfg,
         clock=clock, create_genesis=False, network_want=network_want,
         transport=transport,
@@ -217,5 +238,20 @@ def load_node(
             raise ValueError(
                 "checkpoint replay diverged from the saved decided prefix "
                 "(corrupt checkpoint or consensus-rule drift)"
+            )
+    if membership is not None:
+        from tpu_swirld.membership.epoch import EpochLedger
+
+        # from_meta itself refuses an internally inconsistent document
+        # (epochs edited without re-stamping the digest); the comparison
+        # refuses a consistent-but-wrong ledger (digest re-stamped, or
+        # drift in the activation rule) — either way the replay-derived
+        # epoch sequence is the only accepted truth
+        saved_ledger = EpochLedger.from_meta(membership)
+        if not saved_ledger.same_epochs(node.ledger):
+            raise ValueError(
+                "checkpoint epoch ledger does not match the replay-"
+                "derived ledger (tampered membership header or "
+                "activation-rule drift)"
             )
     return node

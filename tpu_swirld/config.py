@@ -318,6 +318,11 @@ class SwirldConfig:
     net_retry_tick_s: Optional[float] = None       # seconds per logical
                                                    # RetryPolicy backoff tick
 
+    # --- dynamic membership (membership/) ---
+    membership_delay: int = 4    # rounds between a membership tx's decision
+                                 # (round_received of its carrier) and the
+                                 # first round the new MemberEpoch governs
+
     def stakes(self) -> Tuple[int, ...]:
         if self.stake is not None:
             if len(self.stake) != self.n_members:

@@ -1,0 +1,51 @@
+"""Dynamic membership: consensus-agreed, epoch-versioned member sets.
+
+Submodules:
+
+- ``txs``      — the ``MTX1`` membership-transaction wire format
+                 (join / leave / restake payloads riding ordinary events);
+- ``epoch``    — :class:`MemberEpoch` / :class:`EpochLedger`: the
+                 append-only, consensus-derived epoch sequence;
+- ``dynamic``  — :class:`DynamicNode`, the oracle engine with per-round
+                 epoch stake, gossip pre-admission, and deterministic
+                 restatement;
+- ``repack``   — the member-axis repack pass at epoch activation;
+- ``engine``   — :func:`run_dynamic` drivers for all five engines;
+- ``sim``      — dynamic-population gossip simulations + churn schedules.
+"""
+
+from tpu_swirld.membership.epoch import (
+    DEFAULT_DELAY,
+    EpochLedger,
+    MemberEpoch,
+    activation_round,
+    ledger_from_decided,
+)
+from tpu_swirld.membership.txs import (
+    JOIN,
+    LEAVE,
+    RESTAKE,
+    MembershipTx,
+    decode_tx,
+    encode_tx,
+    join_payload,
+    leave_payload,
+    restake_payload,
+)
+
+__all__ = [
+    "DEFAULT_DELAY",
+    "EpochLedger",
+    "MemberEpoch",
+    "MembershipTx",
+    "JOIN",
+    "LEAVE",
+    "RESTAKE",
+    "activation_round",
+    "decode_tx",
+    "encode_tx",
+    "join_payload",
+    "leave_payload",
+    "ledger_from_decided",
+    "restake_payload",
+]

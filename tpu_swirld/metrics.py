@@ -135,6 +135,15 @@ def node_gauges(
         # the last round whose order is committed
         "decided_watermark": len(getattr(node, "consensus", ())),
         "decided_round": getattr(node, "consensus_round", 0) - 1,
+        # dynamic-membership surface (membership/): a static node reports
+        # the trivial single-epoch values, so dashboards read one schema
+        "membership_epoch": getattr(node, "membership_epoch", 0),
+        "members_active": getattr(
+            node, "members_active", len(getattr(node, "members", ()))
+        ),
+        "stake_total": getattr(
+            node, "stake_total", getattr(node, "tot_stake", 0)
+        ),
     }
     if registry is not None:
         if node_label is None:

@@ -106,6 +106,9 @@ class ClusterSpec:
     net: Dict = dataclasses.field(default_factory=dict)
     proxy_plan: Optional[object] = None
     external_indices: Tuple[int, ...] = ()
+    #: launch DynamicNode processes (consensus-decided membership); the
+    #: soak harness sets this whenever a MembershipWindow is scheduled
+    dynamic: bool = False
 
     def managed_indices(self) -> List[int]:
         """Member slots this supervisor launches and holds to account."""
@@ -287,6 +290,7 @@ class ClusterSupervisor:
             # (supervisor crash, wedged stop) self-terminates
             "duration_s": spec.duration_s * 3 + 60.0,
             "net": spec.net,
+            "dynamic": spec.dynamic,
         }
         if self.fleet is not None:
             doc["peer_addrs"] = {

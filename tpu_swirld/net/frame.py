@@ -52,6 +52,9 @@ KIND_STATUS = 4     # JSON status probe (supervisor liveness/watermarks)
 KIND_STOP = 5       # graceful shutdown request
 KIND_PING = 6       # readiness probe
 KIND_METRICS = 7    # registry snapshot poll (supervisor metrics plane)
+KIND_MTX = 8        # membership transaction (payload = MTX1 blob; the
+                    # node rides it on its next gossip event — dynamic-
+                    # membership clusters only)
 
 #: kind-byte high bit: a 16-byte trace context follows src_pk
 TRACE_FLAG = 0x80

@@ -274,11 +274,25 @@ class NodeRuntime:
                 sock_transport.register(pk_j, h, p)
         self.transport = sock_transport
         yielding = _YieldingTransport(sock_transport, self.lock)
+        self.dynamic = bool(spec.get("dynamic"))
+        #: queued MTX1 blobs (KIND_MTX): each rides one gossip event's
+        #: payload whole — membership txs are never batched with client
+        #: txs, because decode_tx reads the full event payload
+        self._pending_mtx: List[bytes] = []
         self.restored = os.path.exists(self.paths["ckpt"])
         if self.restored:
+            # a dynamic node's checkpoint carries its membership header,
+            # so load_node restores the right class on its own
             self.node = load_node(
                 self.paths["ckpt"], sk=self.sk, pk=self.pk, network={},
                 transport=yielding,
+            )
+        elif self.dynamic:
+            from tpu_swirld.membership.dynamic import DynamicNode
+
+            self.node = DynamicNode(
+                sk=self.sk, pk=self.pk, network={}, members=self.members,
+                config=self.config, transport=yielding,
             )
         else:
             self.node = Node(
@@ -362,6 +376,15 @@ class NodeRuntime:
                     if own_ctx:
                         self._remember_trace(txid, own_ctx)
             return frame.STATUS_OK, reply
+        if kind == frame.KIND_MTX:
+            if not hasattr(self.node, "ledger"):
+                return frame.STATUS_ERR, b"MTX:static-cluster"
+            from tpu_swirld.membership.txs import decode_tx
+            if decode_tx(payload) is None:
+                return frame.STATUS_ERR, b"MTX:malformed"
+            with self.lock:
+                self._pending_mtx.append(payload)
+            return frame.STATUS_OK, b"MTX:queued"
         if kind == frame.KIND_STATUS:
             with self.lock:
                 body = json.dumps(self.status()).encode()
@@ -414,6 +437,8 @@ class NodeRuntime:
             "recovering": self._recovering(),
             "unclean_start": self.unclean_start,
             "flightrec_dump": self.flightrec_dump,
+            "membership_epoch": getattr(node, "membership_epoch", 0),
+            "pending_mtx": len(self._pending_mtx),
         }
 
     def metrics_snapshot(self) -> Dict:
@@ -440,6 +465,12 @@ class NodeRuntime:
             self.wal.torn_tail_recovered
         )
         reg.gauge("trace_events").set(len(self.tracer.events))
+        reg.gauge("membership_epoch").set(
+            getattr(node, "membership_epoch", 0))
+        reg.gauge("members_active").set(
+            getattr(node, "members_active", len(node.members)))
+        reg.gauge("stake_total").set(
+            getattr(node, "stake_total", node.tot_stake))
         return {
             "node": self.label,
             "index": self.index,
@@ -469,10 +500,19 @@ class NodeRuntime:
         else:
             # a batch is only drained when the sync will actually create
             # an event (sync is a no-op until the peer is known) — a
-            # batch fed to a no-op sync would be silently dropped
-            batch = (
-                self.pool.next_batch() if node.member_events[peer] else b""
-            )
+            # batch fed to a no-op sync would be silently dropped.  A
+            # queued membership tx takes the turn's payload slot whole
+            # (client batches wait one turn): decode_tx reads the full
+            # event payload, so an MTX1 blob can never share an event
+            # with a client batch.
+            mtx = None
+            if not node.member_events[peer]:
+                batch = b""
+            elif self._pending_mtx:
+                mtx = batch = self._pending_mtx.pop(0)
+            else:
+                batch = self.pool.next_batch()
+            prev_head = node.head
             ctx = self._batch_trace(batch)
             if ctx:
                 with self.tracer.span_under("gossip.sync", ctx) as sp:
@@ -487,6 +527,11 @@ class NodeRuntime:
                         self._gossip_ctx = b""
             else:
                 self._sync_step(peer, batch)
+            if mtx is not None and node.head == prev_head:
+                # the sync minted no event (transport failure, circuit
+                # breaker): the membership tx must not vanish — requeue
+                # it for the next turn
+                self._pending_mtx.insert(0, mtx)
         self._record_decided()
 
     def _sync_step(self, peer: bytes, batch: bytes) -> None:
@@ -626,6 +671,14 @@ class NodeRuntime:
             "decided": [e.hex() for e in node.consensus],
             "decided_tx": self.decided_tx,
             "events": len(node.hg),
+            "membership_epoch": getattr(node, "membership_epoch", 0),
+            "membership_epochs": (
+                len(node.ledger.epochs)
+                if hasattr(node, "ledger") else 1
+            ),
+            "members_active": getattr(
+                node, "members_active", len(node.members)),
+            "stake_total": getattr(node, "stake_total", node.tot_stake),
             "counters": counters,
             "finality": self.tracker.summary(),
             "ttf_samples": list(self.tracker.ttf),

@@ -11,7 +11,8 @@ and prints:
    the trace, i.e. the registry snapshot at save time — split into
    protocol / store / cluster-traffic (tx ingestion, WAL recovery) /
    finality (rounds-to-decision, time-to-finality, decided watermarks) /
-   flight-recorder (trigger + dump counters) / resilience sections.
+   flight-recorder (trigger + dump counters) / membership (epoch, active
+   members, total stake) / resilience sections.
 
 Two additional modes (PR 16):
 
@@ -147,6 +148,22 @@ def is_net_row(g: Dict) -> bool:
     return any(g["name"].startswith(p) for p in _NET_PREFIXES)
 
 
+# The dynamic-membership surface (membership/): the epoch governing the
+# round frontier, the live member count, and the epoch's total stake —
+# published by metrics.node_gauges for static and dynamic nodes alike
+# (static nodes report the trivial single-epoch values).
+_MEMBERSHIP_PREFIXES = (
+    "node_membership_",
+    "node_members_active",
+    "node_stake_total",
+    "membership_",
+)
+
+
+def is_membership_row(g: Dict) -> bool:
+    return any(g["name"].startswith(p) for p in _MEMBERSHIP_PREFIXES)
+
+
 # The finality lifecycle surface: rounds-to-decision / time-to-finality
 # histogram rows (per engine, with the streaming phase dimension),
 # gossip-propagation latency, and per-node decided-watermark gauges.
@@ -211,11 +228,19 @@ def render_report(events: List[Dict]) -> str:
         and not is_resilience_row(g) and not is_store_row(g)
         and not is_net_row(g)
     ]
+    membership = [
+        g for g in gauges
+        if is_membership_row(g)
+        and not is_resilience_row(g) and not is_store_row(g)
+        and not is_net_row(g)
+        and not is_finality_row(g) and not is_flightrec_row(g)
+    ]
     protocol = [
         g for g in gauges
         if not is_resilience_row(g) and not is_store_row(g)
         and not is_net_row(g)
         and not is_finality_row(g) and not is_flightrec_row(g)
+        and not is_membership_row(g)
     ]
     lines.append("")
     lines.append("== protocol gauges ==")
@@ -249,6 +274,12 @@ def render_report(events: List[Dict]) -> str:
         lines.append("== flight recorder (triggers / dumps) ==")
         width = max(len(_gauge_name(g)) for g in flightrec)
         for g in flightrec:
+            lines.append(f"{_gauge_name(g):<{width}}  {g['value']}")
+    if membership:
+        lines.append("")
+        lines.append("== membership (epoch / active members / stake) ==")
+        width = max(len(_gauge_name(g)) for g in membership)
+        for g in membership:
             lines.append(f"{_gauge_name(g):<{width}}  {g['value']}")
     if resilience:
         lines.append("")
@@ -381,6 +412,16 @@ def render_cluster_report(dirpath: str) -> str:
                 f" ttf_p50={_fmt(fin.get('ttf_p50'))}"
                 f" ttf_p99={_fmt(fin.get('ttf_p99'))}"
                 f" undecided={_fmt(fin.get('undecided'))}"
+            )
+        lines.append("")
+        lines.append("== membership (per node) ==")
+        for r in reports:
+            lines.append(
+                f"{_fmt(r.get('node')):<6}"
+                f" epoch={_fmt(r.get('membership_epoch'))}"
+                f" epochs_decided={_fmt(r.get('membership_epochs'))}"
+                f" members_active={_fmt(r.get('members_active'))}"
+                f" stake_total={_fmt(r.get('stake_total'))}"
             )
         _counter_section(
             lines, "shed / backpressure", reports,

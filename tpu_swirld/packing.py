@@ -95,6 +95,36 @@ class Packer:
     def __len__(self) -> int:
         return self._n
 
+    # ---- dynamic membership (epoch repack seam: membership.repack)
+
+    def add_member(self, pk: bytes) -> int:
+        """Append one member row (a decided JOIN): the member axis only
+        ever *extends*, so existing event indices, fork pairs, and every
+        snapshot stay valid.  Returns the new member index."""
+        if pk in self.member_index:
+            return self.member_index[pk]
+        i = len(self.members)
+        self.members.append(pk)
+        self.member_index[pk] = i
+        counts = np.zeros((i + 1,), dtype=np.int32)
+        counts[:i] = self._member_counts
+        self._member_counts = counts
+        self._by_seq.append({})
+        table = np.full((i + 1, self._k), -1, dtype=np.int32)
+        table[:i] = self._member_table
+        self._member_table = table
+        stake = np.zeros((i + 1,), dtype=np.int32)
+        stake[:i] = self.stake
+        self.stake = stake
+        return i
+
+    def set_stake(self, stake: Sequence[int]) -> None:
+        """Swap the stake vector (a decided LEAVE/RESTAKE or an epoch
+        activation).  Length must match the member axis."""
+        if len(stake) != len(self.members):
+            raise ValueError("stake length != member count")
+        self.stake = np.asarray(stake, dtype=np.int32)
+
     def _grow(self, need: int) -> None:
         cap = self._parents.shape[0]
         if need <= cap:

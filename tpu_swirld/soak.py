@@ -123,10 +123,32 @@ class AttackWindow:
     step_every_s: float = 0.25
 
 
+@dataclasses.dataclass(frozen=True)
+class MembershipWindow:
+    """Inject one membership transaction at ``at_s``: the orchestrator
+    sends an ``MTX1`` blob (``KIND_MTX``) to the first reachable honest
+    node, which rides it on its next gossip event; the change decides
+    and activates through ordinary consensus.
+
+    Scheduling any membership window flips the cluster to
+    :class:`~tpu_swirld.membership.dynamic.DynamicNode` processes.
+    ``action`` is ``restake`` (member's stake becomes ``stake``) or
+    ``leave`` (stake zeroed; the slot's process keeps gossiping as a
+    zero-stake participant — its events order but carry no vote).
+    ``join`` is not a soak action: a fresh member would need a fresh
+    process slot, which the fixed-fleet supervisor cannot mint."""
+
+    at_s: float
+    action: str = "restake"
+    member: int = 1
+    stake: int = 3
+
+
 _WINDOW_KINDS = {
     "crash": CrashWindow,
     "partition": PartitionWindow,
     "attack": AttackWindow,
+    "membership": MembershipWindow,
 }
 
 
@@ -150,7 +172,11 @@ def window_from_dict(d: Dict):
 
 def window_end_s(w) -> float:
     """When the disruption is over (the liveness mark's anchor)."""
-    return w.restart_at_s if isinstance(w, CrashWindow) else w.end_s
+    if isinstance(w, CrashWindow):
+        return w.restart_at_s
+    if isinstance(w, MembershipWindow):
+        return w.at_s
+    return w.end_s
 
 
 # -------------------------------------------------------------------- spec
@@ -175,6 +201,10 @@ class SoakSpec:
     mutate: Optional[str] = None
     net: Dict = dataclasses.field(default_factory=dict)
     flightrec_dir: Optional[str] = None
+    #: DynamicNode cluster; auto-set when the schedule holds any
+    #: MembershipWindow (kept explicit so ddmin removing the last
+    #: membership window still replays the same node class)
+    dynamic: bool = False
 
 
 def default_spec(workdir: str, config=None, **overrides) -> SoakSpec:
@@ -327,6 +357,16 @@ class _AdversaryHost:
 
 # --------------------------------------------------------------- orchestra
 
+def _mtx_payload(w: MembershipWindow, members: List[bytes]) -> bytes:
+    from tpu_swirld.membership.txs import leave_payload, restake_payload
+
+    if w.action == "restake":
+        return restake_payload(members[w.member], w.stake)
+    if w.action == "leave":
+        return leave_payload(members[w.member])
+    raise ValueError(f"unknown membership action {w.action!r}")
+
+
 def _decided_min(sup: ClusterSupervisor, indices: List[int]) -> int:
     """The lagging decided frontier over the reachable honest nodes."""
     decided = []
@@ -349,6 +389,8 @@ def run_soak(spec: SoakSpec) -> Dict:
     attacks = [w for w in schedule if isinstance(w, AttackWindow)]
     crashes = [w for w in schedule if isinstance(w, CrashWindow)]
     partitions = [w for w in schedule if isinstance(w, PartitionWindow)]
+    memberships = [w for w in schedule if isinstance(w, MembershipWindow)]
+    dynamic = spec.dynamic or bool(memberships)
     byz = tuple(sorted({w.index for w in attacks}))
     plan = FaultPlan(
         seed=spec.seed,
@@ -375,6 +417,7 @@ def run_soak(spec: SoakSpec) -> Dict:
         net=net,
         proxy_plan=plan,
         external_indices=byz,
+        dynamic=dynamic,
     )
     honest = cspec.managed_indices()
     sup = ClusterSupervisor(cspec)
@@ -420,6 +463,9 @@ def run_soak(spec: SoakSpec) -> Dict:
         traffic.start()
         pending_kills = sorted(crashes, key=lambda w: w.at_s)
         pending_restarts: List[CrashWindow] = []
+        pending_mtx = sorted(memberships, key=lambda w: w.at_s)
+        member_pks = [pk for pk, _ in member_keys(spec.n_nodes, spec.seed)]
+        mtx_sent = 0
         down: set = set()
         poll_gap = cspec.metrics_poll_s if cspec.metrics_poll_s > 0 else None
         next_poll = t0 + (poll_gap or 0.0)
@@ -440,6 +486,28 @@ def run_soak(spec: SoakSpec) -> Dict:
                     sup.restart(w.index)
                     down.discard(w.index)
                 traffic.retarget([i for i in honest if i not in down])
+            # membership injection: one KIND_MTX to the first reachable
+            # honest node; an all-unreachable tick just retries — the
+            # window fires late rather than silently dropping the tx
+            while pending_mtx and el >= pending_mtx[0].at_s:
+                w = pending_mtx[0]
+                sent = False
+                for i in honest:
+                    if i in down:
+                        continue
+                    try:
+                        st, _ = sup.client.call(
+                            i, frame.KIND_MTX, _mtx_payload(w, member_pks),
+                        )
+                    except (OSError, ValueError):
+                        continue
+                    if st == frame.STATUS_OK:
+                        sent = True
+                        break
+                if not sent:
+                    break
+                pending_mtx.pop(0)
+                mtx_sent += 1
             for h in hosts:
                 h.maybe_step(el)
             for m in marks:
@@ -472,6 +540,7 @@ def run_soak(spec: SoakSpec) -> Dict:
             traffic.stop()
     return _soak_verdict(
         spec, cspec, sup, traffic, marks, flightrec_dir, hosts,
+        mtx_sent=mtx_sent,
     )
 
 
@@ -483,6 +552,7 @@ def _soak_verdict(
     marks: List[Dict],
     flightrec_dir: str,
     hosts: Optional[List[_AdversaryHost]] = None,
+    mtx_sent: int = 0,
 ) -> Dict:
     honest = cspec.managed_indices()
     members = [pk for pk, _ in member_keys(spec.n_nodes, spec.seed)]
@@ -494,9 +564,15 @@ def _soak_verdict(
         [bytes.fromhex(e) for e in rep["decided"]]
         for _, rep in sorted(reports.items())
     ]
+    oracle_cls = None
+    if cspec.dynamic:
+        from tpu_swirld.membership.dynamic import DynamicNode
+
+        oracle_cls = DynamicNode
     if union and orders:
         oracle = oracle_replay(
             union, members, config, observer_keypair(spec.seed),
+            node_cls=oracle_cls,
         )
         safety = safety_section(orders, oracle)
     else:
@@ -544,12 +620,31 @@ def _soak_verdict(
         counters[name] = sum(
             rep["counters"].get(name, 0) for rep in reports.values()
         )
+    # membership: every injected tx must have decided and activated on
+    # every surviving honest node — epochs = genesis + one per sent tx.
+    # (A dynamic cluster with no windows pins the single-epoch case.)
+    epochs_min = min(
+        (rep.get("membership_epochs", 1) for rep in reports.values()),
+        default=0,
+    )
+    membership = {
+        "dynamic": bool(cspec.dynamic),
+        "mtx_sent": mtx_sent,
+        "epochs_min": epochs_min,
+        "epochs_expected": 1 + mtx_sent,
+        "active_epoch_min": min(
+            (rep.get("membership_epoch", 1) for rep in reports.values()),
+            default=0,
+        ),
+        "ok": (not cspec.dynamic) or epochs_min >= 1 + mtx_sent,
+    }
     ok = (
         verdict_ok(safety, liveness)
         and disruptions_survived == len(marks)
         and finality["ok"]
         and bool(accounting.get("balance_ok"))
         and reports_ok
+        and membership["ok"]
     )
     # soak gauges + the black box: a red verdict dumps its own forensics
     registry = Registry()
@@ -576,6 +671,7 @@ def _soak_verdict(
                 "finality_ok": finality["ok"],
                 "accounting_ok": bool(accounting.get("balance_ok")),
                 "reports_ok": reports_ok,
+                "membership_ok": membership["ok"],
             },
             decided_frontier=decided_final,
             registry=registry,
@@ -589,6 +685,7 @@ def _soak_verdict(
         "accounting": accounting,
         "disruptions_survived": disruptions_survived,
         "disruptions_total": len(marks),
+        "membership": membership,
         "tx_per_s": accounting.get("tx_per_s", 0.0),
         "submit_p99_s": accounting.get("submit_p99_s", 0.0),
         "counters": counters,
